@@ -1,0 +1,37 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+Capabilities of Horovod (reference huyutuo/horovod 0.20.3), rebuilt
+idiomatically for TPU: XLA collectives over ICI/DCN replace NCCL/MPI in the
+data plane, a self-contained TCP control plane replaces Gloo/MPI
+coordination, and jax/pjit mesh parallelism (dp/tp/sp/pp/ep + ring
+attention) is first-class.
+
+The default public API is the jax binding::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    grads = hvd.allreduce(grads)
+"""
+
+from .version import __version__  # noqa: F401
+
+# The jax binding is the default flavor, mirroring how the reference exposes
+# `import horovod.torch as hvd`. Imported lazily so that `horovod_tpu.common`
+# stays importable in minimal environments.
+
+
+def __getattr__(name):
+    if name.startswith("_") or name == "frameworks":
+        # Don't recurse through the import fallback (the import system probes
+        # the package __getattr__ for missing submodules).
+        raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")
+    try:
+        from .frameworks import jax as _jax_api
+    except ImportError as e:
+        raise AttributeError(
+            f"module 'horovod_tpu' has no attribute {name!r} "
+            f"(jax binding unavailable: {e})") from None
+    try:
+        return getattr(_jax_api, name)
+    except AttributeError:
+        raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}") from None

@@ -1,0 +1,9 @@
+from . import env  # noqa: F401
+from .exceptions import (  # noqa: F401
+    DuplicateNameError,
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    StalledTensorError,
+    TensorShapeError,
+)
+from .topology import ProcessTopology, from_env  # noqa: F401

@@ -1,0 +1,87 @@
+"""Environment-variable knobs — the single source of config truth.
+
+The reference centralizes all runtime knobs as ``HOROVOD_*`` environment
+variables (``horovod/common/common.h:64-91``, parsed in ``env_parser.cc`` and
+``operations.cc:404-540``); the launcher converts CLI flags into these
+variables (``runner/common/util/config_parser.py``).  We keep the same model
+and, where a knob has a direct equivalent, the same name, so that operational
+knowledge transfers.
+"""
+
+from __future__ import annotations
+
+import os
+
+# -- topology (set by the launcher / rendezvous; reference gloo_run.py:65-76) --
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+
+# -- rendezvous / control plane --
+HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"  # "tcp" (our gloo-role) | "local"
+HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+
+# -- core runtime tunables (reference common.h:64-91) --
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"  # bytes, default 64MB
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"  # float ms, default 1.0 here (5.0 in ref)
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIMESTAMP = "HOROVOD_LOG_HIDE_TIMESTAMP"
+HOROVOD_ADASUM_MPI_CHUNK_SIZE = "HOROVOD_ADASUM_MPI_CHUNK_SIZE"
+
+# -- TPU-specific (no reference equivalent: XLA data-plane knobs) --
+HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"  # e.g. "dp:8" or "dp:4,tp:2"
+HOROVOD_XLA_BUCKET_BYTES = "HOROVOD_XLA_BUCKET_BYTES"
+HOROVOD_DATA_PLANE = "HOROVOD_DATA_PLANE"  # "xla" | "tcp" | "auto"
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+# Reference default cycle is 5 ms (operations.cc:458); our control plane is
+# Python so we default lower to keep small-tensor latency reasonable.
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_CHECK_TIME_SECONDS = 60
+DEFAULT_STALL_SHUTDOWN_TIME_SECONDS = 0  # disabled
+
+
+def get_int(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return default
+    return int(val)
+
+
+def get_float(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return default
+    return float(val)
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return default
+    return val.lower() not in ("0", "false", "no", "off", "")
+
+
+def get_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)

@@ -1,0 +1,47 @@
+"""Framework exceptions.
+
+Mirrors the role of the reference's ``horovod/common/exceptions.py:1-31``:
+``HorovodInternalError`` signals a failed collective (peer death, transport
+error) that elastic training recovers from by rolling back to the last
+committed state; ``HostsUpdatedInterrupt`` signals that the elastic driver
+discovered a host-set change and the worker should re-rendezvous without
+losing state.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective operation fails.
+
+    Elastic mode catches this, restores the last committed state and
+    re-initializes the job (reference ``common/elastic.py:147-168``).
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised when the host set changed and the job should re-rendezvous.
+
+    ``skip_sync`` mirrors the reference: when the interrupt was caused by a
+    host *addition* (no failure), the current state is intact and the
+    post-reset ``state.sync()`` broadcast can be skipped.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class TensorShapeError(ValueError):
+    """Cross-rank tensor shape/dtype mismatch detected by the controller.
+
+    The reference surfaces these as ``Response::ERROR`` from
+    ``ConstructResponse`` (``controller.cc:547-824``)."""
+
+
+class DuplicateNameError(ValueError):
+    """A tensor with the same name is already in flight.
+
+    Reference: ``DUPLICATE_NAME_ERROR`` status (``common.h:164-167``)."""
+
+
+class StalledTensorError(RuntimeError):
+    """A tensor stalled past the shutdown threshold (stall inspector)."""

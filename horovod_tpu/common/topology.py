@@ -1,0 +1,79 @@
+"""Process topology: rank / size / local / cross coordinates.
+
+The reference discovers these either from MPI communicator splits
+(``mpi_context.cc:147-156``: COMM_WORLD + per-node ``local`` via
+``MPI_Comm_split_type(COMM_TYPE_SHARED)`` + one-rank-per-node ``cross``) or
+from launcher-provided env vars in the Gloo path
+(``gloo_context.cc:139-144``).  We are MPI-free by design, so the env path is
+the only path: the launcher computes a slot table (rank, local_rank,
+cross_rank per slot — reference ``runner/common/util/hosts.py``) and exports
+it to each worker process.
+
+The three communicator scopes map to TPU fabric tiers: GLOBAL spans the whole
+job, LOCAL is one host (chips linked by ICI within a pod slice share a host
+group), CROSS is one process per host (traffic that rides DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+
+from . import env
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessTopology:
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+    hostname: str = ""
+
+    def __post_init__(self):
+        if not (0 <= self.rank < self.size):
+            raise ValueError(f"rank {self.rank} out of range for size {self.size}")
+        if not (0 <= self.local_rank < self.local_size):
+            raise ValueError(
+                f"local_rank {self.local_rank} out of range for local_size {self.local_size}")
+        if not (0 <= self.cross_rank < self.cross_size):
+            raise ValueError(
+                f"cross_rank {self.cross_rank} out of range for cross_size {self.cross_size}")
+        if self.local_size * self.cross_size < self.size:
+            raise ValueError(
+                f"local_size {self.local_size} * cross_size {self.cross_size} "
+                f"cannot cover size {self.size}")
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.rank == 0
+
+    @property
+    def is_homogeneous(self) -> bool:
+        """True when every host has the same number of slots.
+
+        The reference tracks this to decide whether hierarchical collectives
+        are legal (``controller.h``/``controller.cc`` set ``is_homogeneous_``
+        during DoInitialization)."""
+        return self.local_size * self.cross_size == self.size
+
+
+def from_env() -> ProcessTopology:
+    """Build topology from launcher-provided env, defaulting to 1 process.
+
+    Mirrors ``gloo_context.cc:139-144`` (reads HOROVOD_RANK/SIZE/...)."""
+    size = env.get_int(env.HOROVOD_SIZE, 1)
+    # Single-host assumption when the launcher did not say otherwise:
+    # local scope == global scope, one host in the cross scope.
+    return ProcessTopology(
+        rank=env.get_int(env.HOROVOD_RANK, 0),
+        size=size,
+        local_rank=env.get_int(env.HOROVOD_LOCAL_RANK,
+                               env.get_int(env.HOROVOD_RANK, 0)),
+        local_size=env.get_int(env.HOROVOD_LOCAL_SIZE, size),
+        cross_rank=env.get_int(env.HOROVOD_CROSS_RANK, 0),
+        cross_size=env.get_int(env.HOROVOD_CROSS_SIZE, 1),
+        hostname=env.get_str(env.HOROVOD_HOSTNAME, socket.gethostname()),
+    )

@@ -1,0 +1,340 @@
+"""Control-plane wire messages: Request / Response and their lists.
+
+Role of the reference's ``horovod/common/message.h:48-217`` +
+``wire/message.fbs``: every rank describes each tensor it wants to reduce
+with a ``Request`` (name, op, dtype, shape, root rank, pre/post scale);
+the coordinator answers with fused ``Response``s naming the tensors that are
+globally ready.  The reference serializes with FlatBuffers; we use a
+hand-rolled length-prefixed binary format (little-endian, fixed-width struct
+fields) that is deliberately trivial to reimplement in C++ for the native
+controller — no schema compiler needed, and decode is allocation-light.
+
+DataType covers the TPU-relevant set (bfloat16 is first-class; the reference
+only knows fp16 — ``message.h:20-33``).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+import numpy as np
+
+WIRE_MAGIC = 0x48564454  # "HVDT"
+
+
+class DataType(enum.IntEnum):
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    BFLOAT16 = 10
+
+    @property
+    def itemsize(self) -> int:
+        return _ITEMSIZE[self]
+
+    def to_numpy(self) -> np.dtype:
+        return _TO_NUMPY[self]
+
+    @staticmethod
+    def from_numpy(dtype) -> "DataType":
+        key = np.dtype(dtype).name
+        try:
+            return _FROM_NUMPY[key]
+        except KeyError:
+            raise ValueError(f"unsupported dtype {dtype!r}") from None
+
+
+def _bfloat16_dtype():
+    try:
+        import ml_dtypes  # jax's dtype extension package, always present with jax
+
+        return np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover
+        return np.dtype(np.uint16)  # raw-bits fallback
+
+
+_ITEMSIZE = {
+    DataType.UINT8: 1, DataType.INT8: 1, DataType.UINT16: 2, DataType.INT16: 2,
+    DataType.INT32: 4, DataType.INT64: 8, DataType.FLOAT16: 2, DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8, DataType.BOOL: 1, DataType.BFLOAT16: 2,
+}
+
+_TO_NUMPY = {
+    DataType.UINT8: np.dtype(np.uint8), DataType.INT8: np.dtype(np.int8),
+    DataType.UINT16: np.dtype(np.uint16), DataType.INT16: np.dtype(np.int16),
+    DataType.INT32: np.dtype(np.int32), DataType.INT64: np.dtype(np.int64),
+    DataType.FLOAT16: np.dtype(np.float16), DataType.FLOAT32: np.dtype(np.float32),
+    DataType.FLOAT64: np.dtype(np.float64), DataType.BOOL: np.dtype(np.bool_),
+    DataType.BFLOAT16: _bfloat16_dtype(),
+}
+
+_FROM_NUMPY = {
+    "uint8": DataType.UINT8, "int8": DataType.INT8, "uint16": DataType.UINT16,
+    "int16": DataType.INT16, "int32": DataType.INT32, "int64": DataType.INT64,
+    "float16": DataType.FLOAT16, "float32": DataType.FLOAT32,
+    "float64": DataType.FLOAT64, "bool": DataType.BOOL, "bfloat16": DataType.BFLOAT16,
+}
+
+
+class RequestType(enum.IntEnum):
+    """Reference ``message.h:51`` (ALLREDUCE/ALLGATHER/BROADCAST/JOIN/ADASUM/
+    ALLTOALL); BARRIER is our addition for the elastic/commit path."""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+
+
+class ResponseType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    ERROR = 7
+
+
+# ---------------------------------------------------------------------------
+# binary writer/reader helpers
+# ---------------------------------------------------------------------------
+
+class Writer:
+    __slots__ = ("buf",)
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def u8(self, v: int): self.buf += struct.pack("<B", v)
+    def u32(self, v: int): self.buf += struct.pack("<I", v)
+    def i32(self, v: int): self.buf += struct.pack("<i", v)
+    def i64(self, v: int): self.buf += struct.pack("<q", v)
+    def f64(self, v: float): self.buf += struct.pack("<d", v)
+
+    def string(self, s: str):
+        b = s.encode("utf-8")
+        self.u32(len(b))
+        self.buf += b
+
+    def i64_list(self, xs: Sequence[int]):
+        self.u32(len(xs))
+        self.buf += struct.pack(f"<{len(xs)}q", *xs)
+
+    def i32_list(self, xs: Sequence[int]):
+        self.u32(len(xs))
+        self.buf += struct.pack(f"<{len(xs)}i", *xs)
+
+    def str_list(self, xs: Sequence[str]):
+        self.u32(len(xs))
+        for s in xs:
+            self.string(s)
+
+    def getvalue(self) -> bytes:
+        return bytes(self.buf)
+
+
+class Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, fmt: str, size: int):
+        v = struct.unpack_from(fmt, self.buf, self.pos)[0]
+        self.pos += size
+        return v
+
+    def u8(self) -> int: return self._take("<B", 1)
+    def u32(self) -> int: return self._take("<I", 4)
+    def i32(self) -> int: return self._take("<i", 4)
+    def i64(self) -> int: return self._take("<q", 8)
+    def f64(self) -> float: return self._take("<d", 8)
+
+    def string(self) -> str:
+        n = self.u32()
+        s = self.buf[self.pos:self.pos + n].decode("utf-8")
+        self.pos += n
+        return s
+
+    def i64_list(self) -> List[int]:
+        n = self.u32()
+        out = list(struct.unpack_from(f"<{n}q", self.buf, self.pos))
+        self.pos += 8 * n
+        return out
+
+    def i32_list(self) -> List[int]:
+        n = self.u32()
+        out = list(struct.unpack_from(f"<{n}i", self.buf, self.pos))
+        self.pos += 4 * n
+        return out
+
+    def str_list(self) -> List[str]:
+        return [self.string() for _ in range(self.u32())]
+
+
+# ---------------------------------------------------------------------------
+# messages
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Request:
+    """One rank's declaration that a named tensor is ready.
+
+    Reference ``message.h:48-113``."""
+
+    request_rank: int = 0
+    request_type: RequestType = RequestType.ALLREDUCE
+    tensor_name: str = ""
+    tensor_type: DataType = DataType.FLOAT32
+    tensor_shape: List[int] = field(default_factory=list)
+    root_rank: int = -1          # broadcast only
+    device: int = -1             # -1 = host memory
+    group_id: int = -1           # grouped allreduce
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+
+    def serialize(self, w: Writer) -> None:
+        w.u32(self.request_rank)
+        w.u8(int(self.request_type))
+        w.string(self.tensor_name)
+        w.u8(int(self.tensor_type))
+        w.i64_list(self.tensor_shape)
+        w.i32(self.root_rank)
+        w.i32(self.device)
+        w.i32(self.group_id)
+        w.f64(self.prescale_factor)
+        w.f64(self.postscale_factor)
+
+    @staticmethod
+    def deserialize(r: Reader) -> "Request":
+        return Request(
+            request_rank=r.u32(),
+            request_type=RequestType(r.u8()),
+            tensor_name=r.string(),
+            tensor_type=DataType(r.u8()),
+            tensor_shape=r.i64_list(),
+            root_rank=r.i32(),
+            device=r.i32(),
+            group_id=r.i32(),
+            prescale_factor=r.f64(),
+            postscale_factor=r.f64(),
+        )
+
+    @property
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.tensor_shape:
+            n *= d
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.num_elements * self.tensor_type.itemsize
+
+
+@dataclass
+class RequestList:
+    requests: List[Request] = field(default_factory=list)
+    shutdown: bool = False
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        w.u32(WIRE_MAGIC)
+        w.u8(1 if self.shutdown else 0)
+        w.u32(len(self.requests))
+        for req in self.requests:
+            req.serialize(w)
+        return w.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "RequestList":
+        r = Reader(data)
+        if r.u32() != WIRE_MAGIC:
+            raise ValueError("bad request-list magic")
+        shutdown = bool(r.u8())
+        reqs = [Request.deserialize(r) for _ in range(r.u32())]
+        return RequestList(requests=reqs, shutdown=shutdown)
+
+
+@dataclass
+class Response:
+    """Coordinator verdict for one (possibly fused) set of tensors.
+
+    Reference ``message.h:145-217``.  ``tensor_sizes`` carries per-rank first
+    dimensions for ALLGATHER and flattened per-rank recv splits for ALLTOALL
+    (reference packs both into the same field)."""
+
+    response_type: ResponseType = ResponseType.ALLREDUCE
+    tensor_names: List[str] = field(default_factory=list)
+    tensor_type: DataType = DataType.FLOAT32
+    tensor_sizes: List[int] = field(default_factory=list)
+    error_message: str = ""
+    devices: List[int] = field(default_factory=list)
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    last_joined_rank: int = -1
+
+    def serialize(self, w: Writer) -> None:
+        w.u8(int(self.response_type))
+        w.str_list(self.tensor_names)
+        w.u8(int(self.tensor_type))
+        w.i64_list(self.tensor_sizes)
+        w.string(self.error_message)
+        w.i32_list(self.devices)
+        w.f64(self.prescale_factor)
+        w.f64(self.postscale_factor)
+        w.i32(self.last_joined_rank)
+
+    @staticmethod
+    def deserialize(r: Reader) -> "Response":
+        return Response(
+            response_type=ResponseType(r.u8()),
+            tensor_names=r.str_list(),
+            tensor_type=DataType(r.u8()),
+            tensor_sizes=r.i64_list(),
+            error_message=r.string(),
+            devices=r.i32_list(),
+            prescale_factor=r.f64(),
+            postscale_factor=r.f64(),
+            last_joined_rank=r.i32(),
+        )
+
+
+@dataclass
+class ResponseList:
+    responses: List[Response] = field(default_factory=list)
+    shutdown: bool = False
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        w.u32(WIRE_MAGIC)
+        w.u8(1 if self.shutdown else 0)
+        w.u32(len(self.responses))
+        for resp in self.responses:
+            resp.serialize(w)
+        return w.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "ResponseList":
+        r = Reader(data)
+        if r.u32() != WIRE_MAGIC:
+            raise ValueError("bad response-list magic")
+        shutdown = bool(r.u8())
+        resps = [Response.deserialize(r) for _ in range(r.u32())]
+        return ResponseList(responses=resps, shutdown=shutdown)

@@ -1,0 +1,127 @@
+"""Wire-format roundtrip tests (reference analog: message serialization used
+throughout `test/parallel/*`; here tested directly)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core.messages import (
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+)
+
+
+def test_request_roundtrip():
+    req = Request(
+        request_rank=3,
+        request_type=RequestType.ALLREDUCE,
+        tensor_name="layer0/kernel.grad",
+        tensor_type=DataType.BFLOAT16,
+        tensor_shape=[128, 784],
+        root_rank=-1,
+        device=0,
+        prescale_factor=0.5,
+        postscale_factor=0.25,
+    )
+    rl = RequestList(requests=[req, Request(tensor_name="b")], shutdown=False)
+    out = RequestList.from_bytes(rl.to_bytes())
+    assert out.shutdown is False
+    assert len(out.requests) == 2
+    got = out.requests[0]
+    assert got == req
+    assert out.requests[1].tensor_name == "b"
+
+
+def test_request_nbytes():
+    req = Request(tensor_type=DataType.FLOAT32, tensor_shape=[4, 8])
+    assert req.num_elements == 32
+    assert req.nbytes == 128
+
+
+def test_response_roundtrip():
+    resp = Response(
+        response_type=ResponseType.ALLGATHER,
+        tensor_names=["x", "y"],
+        tensor_type=DataType.FLOAT64,
+        tensor_sizes=[5, 9],
+        devices=[0, 1],
+        prescale_factor=2.0,
+        postscale_factor=0.125,
+        last_joined_rank=1,
+    )
+    rl = ResponseList(responses=[resp], shutdown=True)
+    out = ResponseList.from_bytes(rl.to_bytes())
+    assert out.shutdown is True
+    assert out.responses[0] == resp
+
+
+def test_error_response_roundtrip():
+    resp = Response(response_type=ResponseType.ERROR,
+                    tensor_names=["bad"],
+                    error_message="shape mismatch: rank 0 [2] vs rank 1 [3]")
+    out = ResponseList.from_bytes(ResponseList(responses=[resp]).to_bytes())
+    assert out.responses[0].response_type == ResponseType.ERROR
+    assert "mismatch" in out.responses[0].error_message
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(ValueError):
+        RequestList.from_bytes(b"\x00\x00\x00\x00\x00\x00\x00\x00")
+
+
+@pytest.mark.parametrize("np_dtype", [
+    np.uint8, np.int8, np.int32, np.int64, np.float16, np.float32,
+    np.float64, np.bool_,
+])
+def test_dtype_mapping_roundtrip(np_dtype):
+    dt = DataType.from_numpy(np_dtype)
+    assert dt.to_numpy() == np.dtype(np_dtype)
+    assert dt.itemsize == np.dtype(np_dtype).itemsize
+
+
+def test_bfloat16_mapping():
+    import ml_dtypes
+
+    dt = DataType.from_numpy(ml_dtypes.bfloat16)
+    assert dt == DataType.BFLOAT16
+    assert dt.itemsize == 2
+
+
+def test_topology_from_env(monkeypatch):
+    from horovod_tpu.common import topology
+
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "1")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "4")
+    monkeypatch.setenv("HOROVOD_CROSS_RANK", "0")
+    monkeypatch.setenv("HOROVOD_CROSS_SIZE", "2")
+    topo = topology.from_env()
+    assert topo.rank == 3 and topo.size == 8
+    assert topo.local_rank == 1 and topo.local_size == 4
+    assert topo.cross_size == 2
+    assert topo.is_homogeneous
+
+
+def test_topology_defaults(monkeypatch):
+    from horovod_tpu.common import topology
+
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    topo = topology.from_env()
+    assert topo.rank == 0 and topo.size == 1
+    assert topo.is_coordinator
+
+
+def test_topology_validation():
+    from horovod_tpu.common.topology import ProcessTopology
+
+    with pytest.raises(ValueError):
+        ProcessTopology(rank=2, size=2)
+    with pytest.raises(ValueError):
+        ProcessTopology(rank=0, size=4, local_size=2, cross_size=1)
