@@ -1,0 +1,233 @@
+"""Host-memory collective ops over the TCP mesh — the Gloo-role data plane.
+
+Role of the reference's ``horovod/common/ops/gloo_operations.cc`` (CPU,
+MPI-free backend) and the template-method base classes in
+``ops/collective_operations.h:38-256``: fuse entries into one flat buffer,
+run the collective, scatter results back out.  Algorithms:
+
+- allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
+  2·(N−1) steps — same family as NCCL's ring; ``gloo::allreduce`` ring).
+- allgather(v): ring pipeline, N−1 steps of neighbor forwarding.
+- broadcast: star from root (control-plane sizes; tree is a later
+  optimization).
+- alltoall(v): pairwise exchange, N−1 rounds of offset sendrecv.
+
+These run on numpy buffers and serve CPU deployments, multi-process tests,
+and as the cross-host fallback; the XLA backend (``backend/xla.py``) is the
+TPU data plane.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..common.topology import ProcessTopology
+from ..core.messages import DataType, Response, ResponseType
+from ..core.tensor_queue import Status, TensorTableEntry
+from ..transport.tcp import TcpMesh
+
+
+class CollectiveOp:
+    """Base op: ``HorovodOp::Execute(entries, response)`` +
+    ``Enabled(...)`` (reference ``collective_operations.h:38-87``)."""
+
+    def __init__(self, topo: ProcessTopology, mesh: Optional[TcpMesh]):
+        self.topo = topo
+        self.mesh = mesh
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        raise NotImplementedError
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        raise NotImplementedError
+
+
+def _accum_dtype(dtype: np.dtype) -> np.dtype:
+    """Accumulate low-precision floats in fp32 (the reference's fp16 MPI sum
+    op and Adasum both widen; bf16 has ~8 bits of mantissa, so naive ring
+    accumulation would lose gradient mass)."""
+    if dtype.itemsize <= 2 and np.issubdtype(dtype, np.floating):
+        return np.dtype(np.float32)
+    name = getattr(dtype, "name", "")
+    if name == "bfloat16":
+        return np.dtype(np.float32)
+    return dtype
+
+
+def fuse_entries(entries: List[TensorTableEntry], dtype: np.dtype) -> np.ndarray:
+    """MemcpyInFusionBuffer analog (``collective_operations.cc``)."""
+    if len(entries) == 1:
+        return np.ascontiguousarray(entries[0].tensor).ravel()
+    return np.concatenate([np.asarray(e.tensor).ravel() for e in entries])
+
+
+def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry]) -> None:
+    """MemcpyOutFusionBuffer analog: slice results into per-entry outputs."""
+    offset = 0
+    for e in entries:
+        n = int(np.asarray(e.tensor).size)
+        e.output = buf[offset:offset + n].reshape(np.asarray(e.tensor).shape)
+        offset += n
+
+
+class RingAllreduce(CollectiveOp):
+    def enabled(self, response, entries) -> bool:
+        return response.response_type in (ResponseType.ALLREDUCE,)
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        np_dtype = response.tensor_type.to_numpy()
+        buf = fuse_entries(entries, np_dtype)
+        acc = _accum_dtype(buf.dtype)
+        work = buf.astype(acc, copy=True)
+
+        if response.prescale_factor != 1.0:
+            work *= response.prescale_factor
+
+        if self.topo.size > 1:
+            work = self._ring_allreduce(work)
+
+        if response.postscale_factor != 1.0:
+            work *= response.postscale_factor
+
+        out = work.astype(np_dtype, copy=False)
+        unfuse_entries(out, entries)
+        return Status.OK()
+
+    def _ring_allreduce(self, buf: np.ndarray) -> np.ndarray:
+        size, rank = self.topo.size, self.topo.rank
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        n = buf.size
+        # chunk c covers [bounds[c], bounds[c+1])
+        base, rem = divmod(n, size)
+        counts = [base + (1 if c < rem else 0) for c in range(size)]
+        bounds = np.cumsum([0] + counts)
+
+        def chunk(c):
+            return buf[bounds[c]:bounds[c + 1]]
+
+        # reduce-scatter: step s, send chunk (rank - s), recv chunk (rank-s-1)
+        for s in range(size - 1):
+            send_c = (rank - s) % size
+            recv_c = (rank - s - 1) % size
+            recv = self.mesh.sendrecv(nxt, chunk(send_c).tobytes(), prv)
+            incoming = np.frombuffer(recv, dtype=buf.dtype)
+            chunk(recv_c)[:] += incoming
+        # allgather: step s, send chunk (rank+1-s), recv chunk (rank-s)
+        for s in range(size - 1):
+            send_c = (rank + 1 - s) % size
+            recv_c = (rank - s) % size
+            recv = self.mesh.sendrecv(nxt, chunk(send_c).tobytes(), prv)
+            chunk(recv_c)[:] = np.frombuffer(recv, dtype=buf.dtype)
+        return buf
+
+
+class RingAllgather(CollectiveOp):
+    def enabled(self, response, entries) -> bool:
+        return response.response_type == ResponseType.ALLGATHER
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        # Single tensor per response (allgather fusion not implemented).
+        entry = entries[0]
+        tensor = np.ascontiguousarray(entry.tensor)
+        size, rank = self.topo.size, self.topo.rank
+        if size == 1:
+            entry.output = tensor.copy()
+            return Status.OK()
+
+        # Per-rank first-dim sizes negotiated by the controller.
+        dim0s = response.tensor_sizes
+        inner = tensor.shape[1:] if tensor.ndim else ()
+        blocks: List[Optional[np.ndarray]] = [None] * size
+        blocks[rank] = tensor
+
+        # ring forwarding: at step s we send the block that originated at
+        # (rank - s) and receive the one originated at (rank - s - 1)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        for s in range(size - 1):
+            send_origin = (rank - s) % size
+            recv_origin = (rank - s - 1) % size
+            got = self.mesh.sendrecv(nxt, blocks[send_origin].tobytes(), prv)
+            arr = np.frombuffer(got, dtype=tensor.dtype).reshape(
+                (dim0s[recv_origin],) + inner)
+            blocks[recv_origin] = arr
+
+        entry.output = np.concatenate([blocks[i] for i in range(size)], axis=0) \
+            if tensor.ndim else np.stack(blocks)
+        return Status.OK()
+
+
+class StarBroadcast(CollectiveOp):
+    def enabled(self, response, entries) -> bool:
+        return response.response_type == ResponseType.BROADCAST
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        entry = entries[0]
+        root = entry.root_rank
+        if self.topo.size == 1:
+            entry.output = np.ascontiguousarray(entry.tensor)
+            return Status.OK()
+        if self.topo.rank == root:
+            data = np.ascontiguousarray(entry.tensor)
+            payload = data.tobytes()
+            for peer in range(self.topo.size):
+                if peer != root:
+                    self.mesh.send(peer, payload)
+            entry.output = data
+        else:
+            raw = self.mesh.recv(root)
+            shape = np.asarray(entry.tensor).shape
+            entry.output = np.frombuffer(
+                raw, dtype=response.tensor_type.to_numpy()).reshape(shape).copy()
+        return Status.OK()
+
+
+class PairwiseAlltoall(CollectiveOp):
+    def enabled(self, response, entries) -> bool:
+        return response.response_type == ResponseType.ALLTOALL
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        entry = entries[0]
+        tensor = np.ascontiguousarray(entry.tensor)
+        size, rank = self.topo.size, self.topo.rank
+        # Flattened N×N split matrix from the controller; row r = rank r's
+        # send splits, so our recv split from rank r is matrix[r][rank].
+        matrix = response.tensor_sizes
+        send_splits = matrix[rank * size:(rank + 1) * size]
+        recv_splits = [matrix[r * size + rank] for r in range(size)]
+        entry.received_splits = recv_splits
+
+        inner = tensor.shape[1:]
+        send_bounds = np.cumsum([0] + list(send_splits))
+        out_blocks: List[Optional[np.ndarray]] = [None] * size
+        out_blocks[rank] = tensor[send_bounds[rank]:send_bounds[rank + 1]]
+
+        for off in range(1, size):
+            to = (rank + off) % size
+            frm = (rank - off) % size
+            payload = tensor[send_bounds[to]:send_bounds[to + 1]].tobytes()
+            got = self.mesh.sendrecv(to, payload, frm)
+            out_blocks[frm] = np.frombuffer(got, dtype=tensor.dtype).reshape(
+                (recv_splits[frm],) + inner)
+
+        entry.output = np.concatenate([out_blocks[i] for i in range(size)], axis=0)
+        return Status.OK()
+
+
+def zero_entry_for(response: Response, index: int, offset_elems: int,
+                   num_elems: int) -> TensorTableEntry:
+    """Zero-substitute a tensor a joined rank never submitted (reference
+    ``tensor_queue.h:39-41`` builds zero tensors for joined ranks)."""
+    dtype = response.tensor_type.to_numpy()
+    return TensorTableEntry(
+        tensor_name=response.tensor_names[index],
+        tensor=np.zeros(num_elems, dtype=dtype),
+        callback=lambda status, entry: None,
+    )

@@ -1,0 +1,393 @@
+"""The coordination controller — global agreement on which named tensors are
+ready everywhere, every cycle.
+
+Role of the reference's ``horovod/common/controller.cc:97-525``
+(``ComputeResponseList``) with the rank-0 coordinator protocol documented at
+``controller.h:68-103``:
+
+  1. every rank drains its TensorQueue into a RequestList;
+  2. workers send their lists to rank 0 (the coordinator); rank 0 tallies
+     per-tensor readiness in a MessageTable (``IncrementTensorCount``,
+     ``controller.cc:1030-1053``);
+  3. when a tensor has been requested by every (non-joined) rank, the
+     coordinator validates cross-rank consistency and builds a Response
+     (``ConstructResponse``, ``controller.cc:547-824``);
+  4. completed responses are fused under the fusion threshold
+     (``FuseResponses``, ``controller.cc:859-998``) and broadcast back;
+  5. every rank executes the ResponseList in identical order.
+
+The reference implements step 2/4 with MPI gather/bcast or gloo
+allgatherv/broadcast; ours run over the self-contained ``TcpMesh``
+(star topology: sequential recv at rank 0, sequential send out — adequate to
+hundreds of ranks for the small control messages involved, and trivially
+replaceable by a tree).
+
+Also here: Join bookkeeping (zero-substitution for finished ranks) and the
+stall inspector hook.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..common.logging_util import get_logger
+from ..common.topology import ProcessTopology
+from ..transport.tcp import TcpMesh
+from .messages import (
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+)
+
+log = get_logger("horovod_tpu.controller")
+
+JOIN_TENSOR_NAME = "__join__"
+BARRIER_TENSOR_NAME = "__barrier__"
+
+
+@dataclass
+class _TableEntry:
+    requests: List[Request] = field(default_factory=list)
+    ranks: Set[int] = field(default_factory=set)
+    first_seen: float = field(default_factory=time.monotonic)
+
+
+class Controller:
+    def __init__(self, topology: ProcessTopology, mesh: Optional[TcpMesh],
+                 fusion_threshold_bytes: int = 64 * 1024 * 1024,
+                 stall_warning_secs: float = 60.0):
+        self.topo = topology
+        self.mesh = mesh
+        self.fusion_threshold = fusion_threshold_bytes
+        self.stall_warning_secs = stall_warning_secs
+        self._message_table: Dict[str, _TableEntry] = {}
+        self._joined_ranks: Set[int] = set()
+        self._last_stall_check = time.monotonic()
+        self.timeline = None  # coordinator-side negotiation lanes
+        # FIFO completion order like the reference: responses are emitted in
+        # the order tensors *complete*, which is deterministic because only
+        # the coordinator decides it.
+
+    # ------------------------------------------------------------------
+    # the per-cycle negotiation round
+    # ------------------------------------------------------------------
+
+    def compute_response_list(self, requests: List[Request],
+                              should_shutdown: bool = False) -> ResponseList:
+        """One synchronous negotiation round. All ranks must call this every
+        cycle; the TCP recv provides the lockstep."""
+        if self.topo.size == 1:
+            return self._single_process_responses(requests, should_shutdown)
+        if self.topo.rank == 0:
+            return self._coordinator_round(requests, should_shutdown)
+        return self._worker_round(requests, should_shutdown)
+
+    def _worker_round(self, requests: List[Request],
+                      should_shutdown: bool) -> ResponseList:
+        payload = RequestList(requests=requests, shutdown=should_shutdown).to_bytes()
+        self.mesh.send(0, payload)
+        return ResponseList.from_bytes(self.mesh.recv(0))
+
+    def _coordinator_round(self, own_requests: List[Request],
+                           should_shutdown: bool) -> ResponseList:
+        ready: List[str] = []
+        for req in own_requests:
+            if self._increment(req):
+                ready.append(req.tensor_name)
+        for worker in range(1, self.topo.size):
+            rl = RequestList.from_bytes(self.mesh.recv(worker))
+            should_shutdown = should_shutdown or rl.shutdown
+            for req in rl.requests:
+                if self._increment(req):
+                    ready.append(req.tensor_name)
+
+        # A JOIN that lands after a tensor's last active-rank request must
+        # still complete that tensor: re-check pending entries against the
+        # updated joined set (the reference re-evaluates the join-adjusted
+        # count inside ComputeResponseList each cycle).
+        if self._joined_ranks:
+            ready_set = set(ready)
+            for name, entry in self._message_table.items():
+                if name in ready_set:
+                    continue
+                needed = self.topo.size - len(self._joined_ranks - entry.ranks)
+                if len(entry.ranks) >= needed:
+                    ready.append(name)
+
+        responses = [self._construct_response(name) for name in ready]
+        responses = [r for r in responses if r is not None]
+        responses = self._fuse_responses(responses)
+        self._check_stalls()
+
+        rlist = ResponseList(responses=responses, shutdown=should_shutdown)
+        payload = rlist.to_bytes()
+        for worker in range(1, self.topo.size):
+            self.mesh.send(worker, payload)
+        return rlist
+
+    def _single_process_responses(self, requests: List[Request],
+                                  should_shutdown: bool) -> ResponseList:
+        responses = []
+        for req in requests:
+            if self._increment(req):
+                resp = self._construct_response(req.tensor_name)
+                if resp is not None:
+                    responses.append(resp)
+        return ResponseList(responses=self._fuse_responses(responses),
+                            shutdown=should_shutdown)
+
+    # ------------------------------------------------------------------
+    # message table
+    # ------------------------------------------------------------------
+
+    def _increment(self, req: Request) -> bool:
+        """Tally one rank's readiness; True when the tensor is globally ready.
+
+        Reference ``IncrementTensorCount`` (``controller.cc:1030-1053``):
+        completion when (requesting ranks) + (joined ranks) covers the world.
+        """
+        if req.request_type == RequestType.JOIN:
+            self._joined_ranks.add(req.request_rank)
+            # Join completes when *every* rank has joined.
+            return len(self._joined_ranks) == self.topo.size
+
+        entry = self._message_table.get(req.tensor_name)
+        if entry is None:
+            entry = self._message_table[req.tensor_name] = _TableEntry()
+            if self.timeline is not None:
+                self.timeline.negotiate_start(req.tensor_name,
+                                              req.request_type.name)
+        if req.request_rank in entry.ranks:
+            log.warning("rank %d re-submitted tensor %s before completion",
+                        req.request_rank, req.tensor_name)
+            return False
+        entry.ranks.add(req.request_rank)
+        entry.requests.append(req)
+        if self.timeline is not None:
+            self.timeline.negotiate_rank_ready(req.tensor_name, req.request_rank)
+        needed = self.topo.size - len(self._joined_ranks - entry.ranks)
+        return len(entry.ranks) >= needed
+
+    # ------------------------------------------------------------------
+    # response construction & validation
+    # ------------------------------------------------------------------
+
+    def _construct_response(self, name: str) -> Optional[Response]:
+        """Validate cross-rank consistency and emit the Response.
+
+        Reference ``ConstructResponse`` (``controller.cc:547-824``): any
+        dtype/op/shape/root/scale disagreement yields an ERROR response that
+        every rank delivers to the waiting callback."""
+        if name == JOIN_TENSOR_NAME or not self._message_table.get(name):
+            if len(self._joined_ranks) == self.topo.size:
+                self._joined_ranks.clear()
+                return Response(response_type=ResponseType.JOIN,
+                                tensor_names=[JOIN_TENSOR_NAME])
+            return None
+
+        entry = self._message_table.pop(name)
+        if self.timeline is not None:
+            self.timeline.negotiate_end(name)
+        reqs = entry.requests
+        first = reqs[0]
+
+        error = None
+        for req in reqs[1:]:
+            if req.tensor_type != first.tensor_type:
+                error = (f"Mismatched data types for {name}: rank "
+                         f"{first.request_rank} sent {first.tensor_type.name}, rank "
+                         f"{req.request_rank} sent {req.tensor_type.name}.")
+                break
+            if req.request_type != first.request_type:
+                error = (f"Mismatched operations for {name}: ranks disagree on "
+                         f"{first.request_type.name} vs {req.request_type.name}.")
+                break
+            if req.prescale_factor != first.prescale_factor or \
+                    req.postscale_factor != first.postscale_factor:
+                error = f"Mismatched pre/postscale factors for {name}."
+                break
+
+        op = first.request_type
+        tensor_sizes: List[int] = []
+        devices = sorted({r.device for r in reqs})
+
+        if error is None and op in (RequestType.ALLREDUCE, RequestType.ADASUM,
+                                    RequestType.BROADCAST):
+            for req in reqs[1:]:
+                if req.tensor_shape != first.tensor_shape:
+                    error = (f"Mismatched {op.name.lower()} tensor shapes for "
+                             f"{name}: rank {first.request_rank} has "
+                             f"{first.tensor_shape}, rank {req.request_rank} has "
+                             f"{req.tensor_shape}.")
+                    break
+            tensor_sizes = [first.num_elements]
+
+        if error is None and op == RequestType.BROADCAST:
+            for req in reqs[1:]:
+                if req.root_rank != first.root_rank:
+                    error = (f"Mismatched broadcast root ranks for {name}: "
+                             f"{first.root_rank} vs {req.root_rank}.")
+                    break
+            # A joined rank has no root_rank/output for a broadcast it never
+            # submitted; like the reference, Join supports allreduce only.
+            if error is None and len(entry.ranks) != self.topo.size:
+                error = (f"broadcast for {name} cannot complete with joined "
+                         f"ranks (Join supports allreduce only).")
+
+        if error is None and op == RequestType.ALLGATHER:
+            # Shapes must agree on every dim except the first; response
+            # carries each rank's first dimension, ordered by rank
+            # (reference packs the same into tensor_sizes).
+            by_rank = sorted(reqs, key=lambda r: r.request_rank)
+            for req in by_rank:
+                if len(req.tensor_shape) != len(first.tensor_shape) or \
+                        req.tensor_shape[1:] != first.tensor_shape[1:]:
+                    error = (f"Mismatched allgather tensor shapes for {name}: "
+                             f"all dims but the first must match "
+                             f"({first.tensor_shape} vs {req.tensor_shape}).")
+                    break
+            if error is None:
+                if len(by_rank) != self.topo.size:
+                    error = (f"allgather for {name} cannot complete with joined "
+                             f"ranks (Join supports allreduce only, as in the "
+                             f"reference JoinOp).")
+                else:
+                    tensor_sizes = [r.tensor_shape[0] if r.tensor_shape else 1
+                                    for r in by_rank]
+
+        if error is None and op == RequestType.ALLTOALL:
+            by_rank = sorted(reqs, key=lambda r: r.request_rank)
+            if len(by_rank) != self.topo.size:
+                error = f"alltoall for {name} cannot complete with joined ranks."
+            else:
+                for req in by_rank:
+                    if len(req.splits) != self.topo.size:
+                        error = (f"alltoall splits for {name} must have one entry "
+                                 f"per rank (rank {req.request_rank} sent "
+                                 f"{len(req.splits)}).")
+                        break
+                    dim0 = req.tensor_shape[0] if req.tensor_shape else 0
+                    if sum(req.splits) != dim0:
+                        error = (f"alltoall splits for {name} sum to "
+                                 f"{sum(req.splits)} but first dimension is "
+                                 f"{dim0} on rank {req.request_rank}.")
+                        break
+                if error is None:
+                    # Flattened N×N send-split matrix, row r = rank r's splits;
+                    # rank k's recv splits are column k.
+                    for req in by_rank:
+                        tensor_sizes.extend(req.splits)
+
+        if error is not None:
+            return Response(response_type=ResponseType.ERROR,
+                            tensor_names=[name], error_message=error)
+
+        rtype = {
+            RequestType.ALLREDUCE: ResponseType.ALLREDUCE,
+            RequestType.ALLGATHER: ResponseType.ALLGATHER,
+            RequestType.BROADCAST: ResponseType.BROADCAST,
+            RequestType.ADASUM: ResponseType.ADASUM,
+            RequestType.ALLTOALL: ResponseType.ALLTOALL,
+            RequestType.BARRIER: ResponseType.BARRIER,
+        }[op]
+        return Response(
+            response_type=rtype,
+            tensor_names=[name],
+            tensor_type=first.tensor_type,
+            tensor_sizes=tensor_sizes,
+            devices=devices,
+            prescale_factor=first.prescale_factor,
+            postscale_factor=first.postscale_factor,
+            last_joined_rank=min(self._joined_ranks) if self._joined_ranks else -1,
+        )
+
+    # ------------------------------------------------------------------
+    # fusion
+    # ------------------------------------------------------------------
+
+    def _fuse_responses(self, responses: List[Response]) -> List[Response]:
+        """Greedy packing of compatible ALLREDUCE responses under the fusion
+        threshold (reference ``FuseResponses``, ``controller.cc:859-998``;
+        we skip its mixed-precision look-ahead — profitable only with the
+        reference's strict FIFO scan)."""
+        fused: List[Response] = []
+        for resp in responses:
+            if resp.response_type not in (ResponseType.ALLREDUCE,):
+                fused.append(resp)
+                continue
+            target = None
+            if fused:
+                last = fused[-1]
+                if (last.response_type == resp.response_type
+                        and last.tensor_type == resp.tensor_type
+                        and last.devices == resp.devices
+                        and last.prescale_factor == resp.prescale_factor
+                        and last.postscale_factor == resp.postscale_factor):
+                    itemsize = resp.tensor_type.itemsize
+                    if (sum(last.tensor_sizes) + sum(resp.tensor_sizes)) * itemsize \
+                            <= self.fusion_threshold:
+                        target = last
+            if target is None:
+                fused.append(resp)
+            else:
+                target.tensor_names.extend(resp.tensor_names)
+                target.tensor_sizes.extend(resp.tensor_sizes)
+        return fused
+
+    # ------------------------------------------------------------------
+    # stall inspection (coordinator-side; reference stall_inspector.cc)
+    # ------------------------------------------------------------------
+
+    def _check_stalls(self) -> None:
+        now = time.monotonic()
+        if self.stall_warning_secs <= 0 or \
+                now - self._last_stall_check < self.stall_warning_secs:
+            return
+        self._last_stall_check = now
+        for name, entry in self._message_table.items():
+            age = now - entry.first_seen
+            if age > self.stall_warning_secs:
+                missing = sorted(set(range(self.topo.size))
+                                 - entry.ranks - self._joined_ranks)
+                log.warning(
+                    "One or more tensors were submitted to be reduced, gathered "
+                    "or broadcasted by subset of ranks and are waiting for the "
+                    "remainder: %s stalled for %.0fs, missing ranks: %s",
+                    name, age, missing)
+
+    # ------------------------------------------------------------------
+    # small collective helpers for init/shutdown/elastic paths
+    # ------------------------------------------------------------------
+
+    def bcast_bytes(self, payload: Optional[bytes], root: int = 0) -> bytes:
+        if self.topo.size == 1:
+            return payload or b""
+        if self.topo.rank == root:
+            for peer in range(self.topo.size):
+                if peer != root:
+                    self.mesh.send(peer, payload or b"")
+            return payload or b""
+        return self.mesh.recv(root)
+
+    def gather_bytes(self, payload: bytes, root: int = 0) -> Optional[List[bytes]]:
+        if self.topo.size == 1:
+            return [payload]
+        if self.topo.rank == root:
+            out: List[Optional[bytes]] = [None] * self.topo.size
+            out[root] = payload
+            for peer in range(self.topo.size):
+                if peer != root:
+                    out[peer] = self.mesh.recv(peer)
+            return out  # type: ignore[return-value]
+        self.mesh.send(root, payload)
+        return None
+
+    def barrier(self) -> None:
+        self.gather_bytes(b"")
+        self.bcast_bytes(b"")

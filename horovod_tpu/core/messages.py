@@ -208,6 +208,10 @@ class Request:
     group_id: int = -1           # grouped allreduce
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # ALLTOALL send splits travel in-band (the reference distributes them via
+    # a separate MPI_Alltoall, ``mpi_controller.cc:212``; in-band is simpler
+    # and lets the coordinator validate consistency).
+    splits: List[int] = field(default_factory=list)
 
     def serialize(self, w: Writer) -> None:
         w.u32(self.request_rank)
@@ -220,6 +224,7 @@ class Request:
         w.i32(self.group_id)
         w.f64(self.prescale_factor)
         w.f64(self.postscale_factor)
+        w.i64_list(self.splits)
 
     @staticmethod
     def deserialize(r: Reader) -> "Request":
@@ -234,6 +239,7 @@ class Request:
             group_id=r.i32(),
             prescale_factor=r.f64(),
             postscale_factor=r.f64(),
+            splits=r.i64_list(),
         )
 
     @property

@@ -1,0 +1,39 @@
+"""Ordered backend dispatch — first enabled op wins.
+
+Role of the reference's ``OperationManager`` (``operation_manager.cc:41-121``):
+each response type has an ordered chain of candidate backend ops (registration
+order at ``operations.cc:145-252``: most-specialized first, host fallback
+last); the first whose ``enabled()`` returns true executes.  Our chains put
+XLA/TPU ops ahead of the TCP-ring host ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..backend.cpu_ring import CollectiveOp
+from .messages import Response, ResponseType
+from .tensor_queue import Status, TensorTableEntry
+
+
+class OperationManager:
+    def __init__(self):
+        self._chains: Dict[ResponseType, List[CollectiveOp]] = {
+            t: [] for t in ResponseType
+        }
+
+    def register(self, response_type: ResponseType, op: CollectiveOp,
+                 front: bool = False) -> None:
+        chain = self._chains[response_type]
+        if front:
+            chain.insert(0, op)
+        else:
+            chain.append(op)
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        for op in self._chains[response.response_type]:
+            if op.enabled(response, entries):
+                return op.execute(response, entries)
+        return Status.error(
+            f"no enabled backend op for {response.response_type.name}")

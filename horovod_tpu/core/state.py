@@ -1,0 +1,383 @@
+"""Global runtime state and the background coordination loop.
+
+Role of the reference's ``HorovodGlobalState`` + ``BackgroundThreadLoop`` /
+``RunLoopOnce`` (``operations.cc:117, 361-689``) and the ``Enqueue*`` entry
+points (``operations.cc:942-1170``): a singleton owning the topology, the
+transport, the controller, the tensor queue and the op chains; a background
+thread that wakes every cycle, runs one negotiation round, and executes the
+agreed responses; framework threads enqueue named tensors with callbacks and
+never touch the network.
+
+The process model is one Python process per Horovod rank (per host or per
+chip), exactly like ``horovodrun``'s worker processes — the background thread
+here is the analog of the reference's C++ background thread, and the
+GIL-free sections (socket I/O, numpy kernels) are where the real work
+happens.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..backend import cpu_ring
+from ..common import env as env_mod
+from ..common.exceptions import HorovodInternalError
+from ..common.logging_util import get_logger
+from ..common.topology import ProcessTopology, from_env
+from ..transport.store import HTTPStoreClient, MemoryStore, Store
+from ..transport.tcp import TcpMesh
+from .controller import BARRIER_TENSOR_NAME, JOIN_TENSOR_NAME, Controller
+from .messages import (
+    DataType,
+    Request,
+    RequestType,
+    Response,
+    ResponseType,
+)
+from .operation_manager import OperationManager
+from .tensor_queue import Status, TensorQueue, TensorTableEntry
+
+log = get_logger("horovod_tpu.state")
+
+
+class HorovodGlobalState:
+    def __init__(self):
+        self.topo: Optional[ProcessTopology] = None
+        self.mesh: Optional[TcpMesh] = None
+        self.controller: Optional[Controller] = None
+        self.tensor_queue = TensorQueue()
+        self.op_manager = OperationManager()
+        self.initialized = threading.Event()
+        self.shutdown_requested = threading.Event()
+        self.shutdown_complete = threading.Event()
+        self.joined = False
+        self.join_event: Optional[threading.Event] = None
+        self.cycle_time_ms = env_mod.DEFAULT_CYCLE_TIME_MS
+        self.background: Optional[threading.Thread] = None
+        self.init_error: Optional[BaseException] = None
+        self.timeline = None  # attached by core.timeline when enabled
+        self.parameter_manager = None  # attached when autotune enabled
+        self.cycle_count = 0
+
+    # ------------------------------------------------------------------
+
+    def initialize(self, store: Optional[Store] = None,
+                   topology: Optional[ProcessTopology] = None) -> None:
+        """``InitializeHorovodOnce`` analog (``operations.cc:693-739``):
+        spawn the background thread, block until transport + controller are
+        up."""
+        if self.initialized.is_set():
+            return
+        self.topo = topology or from_env()
+        self._store = store
+        self.cycle_time_ms = env_mod.get_float(
+            env_mod.HOROVOD_CYCLE_TIME, env_mod.DEFAULT_CYCLE_TIME_MS)
+        self.background = threading.Thread(
+            target=self._background_loop, name="horovod-background", daemon=True)
+        self.background.start()
+        self.initialized.wait()
+        if self.init_error is not None:
+            # Leave the object retryable: the background thread is dead and
+            # nothing must look initialized.
+            err, self.init_error = self.init_error, None
+            self.initialized.clear()
+            self.background = None
+            raise HorovodInternalError(f"initialization failed: {err}") from err
+        atexit.register(self.shutdown)
+
+    def _build_transport(self) -> None:
+        topo = self.topo
+        if topo.size == 1:
+            self.mesh = None
+        else:
+            store = self._store
+            if store is None:
+                addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+                port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+                if not addr or not port:
+                    raise HorovodInternalError(
+                        "size > 1 requires a rendezvous store "
+                        "(HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT, set by the launcher)")
+                store = HTTPStoreClient(addr, port)
+            self.mesh = TcpMesh(topo.rank, topo.size, store)
+        fusion = env_mod.get_int(
+            env_mod.HOROVOD_FUSION_THRESHOLD, env_mod.DEFAULT_FUSION_THRESHOLD)
+        stall_secs = 0 if env_mod.get_bool(env_mod.HOROVOD_STALL_CHECK_DISABLE) \
+            else env_mod.get_float(env_mod.HOROVOD_STALL_CHECK_TIME_SECONDS,
+                                   env_mod.DEFAULT_STALL_CHECK_TIME_SECONDS)
+        self.controller = Controller(topo, self.mesh,
+                                     fusion_threshold_bytes=fusion,
+                                     stall_warning_secs=stall_secs)
+        timeline_path = env_mod.get_str(env_mod.HOROVOD_TIMELINE)
+        if timeline_path:
+            # Reference writes the timeline only on the coordinator
+            # (operations.cc:424-432).
+            if topo.rank == 0:
+                from .timeline import Timeline
+
+                self.timeline = Timeline(
+                    timeline_path,
+                    mark_cycles=env_mod.get_bool(
+                        env_mod.HOROVOD_TIMELINE_MARK_CYCLES))
+                self.controller.timeline = self.timeline
+        self._register_default_ops()
+
+    def _register_default_ops(self) -> None:
+        topo, mesh = self.topo, self.mesh
+        self.op_manager = OperationManager()
+        self.op_manager.register(
+            ResponseType.ALLREDUCE, cpu_ring.RingAllreduce(topo, mesh))
+        self.op_manager.register(
+            ResponseType.ALLGATHER, cpu_ring.RingAllgather(topo, mesh))
+        self.op_manager.register(
+            ResponseType.BROADCAST, cpu_ring.StarBroadcast(topo, mesh))
+        self.op_manager.register(
+            ResponseType.ALLTOALL, cpu_ring.PairwiseAlltoall(topo, mesh))
+        # ADASUM falls back to ring allreduce until the VHDD op registers.
+        self.op_manager.register(
+            ResponseType.ADASUM, cpu_ring.RingAllreduce(topo, mesh))
+
+    # ------------------------------------------------------------------
+    # background loop
+    # ------------------------------------------------------------------
+
+    def _background_loop(self) -> None:
+        try:
+            self._build_transport()
+        except BaseException as e:  # noqa: BLE001
+            self.init_error = e
+            self.initialized.set()
+            return
+        self.initialized.set()
+
+        cycle = self.cycle_time_ms / 1000.0
+        try:
+            while True:
+                start = time.monotonic()
+                if not self._run_loop_once():
+                    break
+                elapsed = time.monotonic() - start
+                if elapsed < cycle:
+                    time.sleep(cycle - elapsed)
+        except BaseException as e:  # noqa: BLE001
+            log.error("background loop died: %s", e, exc_info=True)
+            self._fail_all_pending(str(e))
+        finally:
+            if self.mesh is not None:
+                self.mesh.close()
+            if self.timeline is not None:
+                self.timeline.close()
+            self.shutdown_complete.set()
+
+    def _run_loop_once(self) -> bool:
+        """One cycle (``RunLoopOnce``, ``operations.cc:595-689``): negotiate,
+        then execute every agreed response. Returns False to stop."""
+        requests = self.tensor_queue.pop_messages()
+        response_list = self.controller.compute_response_list(
+            requests, self.shutdown_requested.is_set())
+        self.cycle_count += 1
+        if self.timeline is not None:
+            self.timeline.mark_cycle()
+        for response in response_list.responses:
+            self._perform_operation(response)
+        return not response_list.shutdown
+
+    def _perform_operation(self, response: Response) -> None:
+        """``PerformOperation`` analog (``operations.cc:256-336``)."""
+        if response.response_type == ResponseType.JOIN:
+            self.joined = False
+            if self.join_event is not None:
+                self.join_event.set()
+                self.join_event = None
+            return
+
+        entries = self.tensor_queue.get_entries_for_response(response)
+
+        if response.response_type == ResponseType.ERROR:
+            for e in entries:
+                e.callback(Status.error(response.error_message), e)
+            return
+
+        if response.response_type == ResponseType.BARRIER:
+            for e in entries:
+                e.callback(Status.OK(), e)
+            return
+
+        # Zero-substitution: a joined rank executes collectives it never
+        # submitted, contributing zeros (reference tensor_queue.h:39-41).
+        if len(entries) != len(response.tensor_names):
+            by_name = {e.tensor_name: e for e in entries}
+            aligned: List[TensorTableEntry] = []
+            for i, name in enumerate(response.tensor_names):
+                if name in by_name:
+                    aligned.append(by_name[name])
+                else:
+                    n = response.tensor_sizes[i] if i < len(response.tensor_sizes) else 0
+                    aligned.append(cpu_ring.zero_entry_for(response, i, 0, n))
+            entries = aligned
+
+        if self.timeline is not None:
+            self.timeline.op_start(response, entries)
+        try:
+            status = self.op_manager.execute(response, entries)
+        except HorovodInternalError as e:
+            status = Status.error(str(e))
+        except Exception as e:  # noqa: BLE001
+            log.error("op execution failed: %s", e, exc_info=True)
+            status = Status.error(f"{type(e).__name__}: {e}")
+        if self.timeline is not None:
+            self.timeline.op_end(response, entries)
+        for e in entries:
+            e.callback(status, e)
+
+    def _fail_all_pending(self, msg: str) -> None:
+        for name in self.tensor_queue.names():
+            entry = self.tensor_queue.remove(name)
+            if entry is not None:
+                entry.callback(Status.error(msg), entry)
+        # A thread blocked in hvd.join() must not sleep forever either.
+        if self.join_event is not None:
+            self.joined = False
+            self.join_event.set()
+            self.join_event = None
+
+    # ------------------------------------------------------------------
+    # framework-facing enqueue API (EnqueueTensor*, operations.cc:942-1170)
+    # ------------------------------------------------------------------
+
+    def _check_initialized(self) -> None:
+        if not self.initialized.is_set() or self.topo is None:
+            raise HorovodInternalError(
+                "horovod_tpu has not been initialized; call hvd.init() first.")
+        if self.init_error is not None:
+            raise HorovodInternalError(f"initialization failed: {self.init_error}")
+
+    def enqueue_allreduce(self, name: str, tensor: np.ndarray,
+                          callback: Callable[[Status], None],
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          op: RequestType = RequestType.ALLREDUCE) -> None:
+        self._check_initialized()
+        tensor = np.asarray(tensor)
+        entry = TensorTableEntry(
+            tensor_name=name, tensor=tensor, callback=callback,
+            request_type=op,
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+        req = Request(
+            request_rank=self.topo.rank, request_type=op,
+            tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
+            tensor_shape=list(tensor.shape),
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+        self.tensor_queue.add(entry, req)
+
+    def enqueue_allgather(self, name: str, tensor: np.ndarray,
+                          callback: Callable[[Status], None]) -> None:
+        self._check_initialized()
+        tensor = np.atleast_1d(np.asarray(tensor))
+        entry = TensorTableEntry(tensor_name=name, tensor=tensor,
+                                 callback=callback,
+                                 request_type=RequestType.ALLGATHER)
+        req = Request(
+            request_rank=self.topo.rank, request_type=RequestType.ALLGATHER,
+            tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
+            tensor_shape=list(tensor.shape))
+        self.tensor_queue.add(entry, req)
+
+    def enqueue_broadcast(self, name: str, tensor: np.ndarray, root_rank: int,
+                          callback: Callable[[Status], None]) -> None:
+        self._check_initialized()
+        tensor = np.asarray(tensor)
+        entry = TensorTableEntry(tensor_name=name, tensor=tensor,
+                                 root_rank=root_rank, callback=callback,
+                                 request_type=RequestType.BROADCAST)
+        req = Request(
+            request_rank=self.topo.rank, request_type=RequestType.BROADCAST,
+            tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
+            tensor_shape=list(tensor.shape), root_rank=root_rank)
+        self.tensor_queue.add(entry, req)
+
+    def enqueue_alltoall(self, name: str, tensor: np.ndarray,
+                         splits: Optional[List[int]],
+                         callback: Callable[[Status], None]) -> None:
+        self._check_initialized()
+        tensor = np.atleast_1d(np.asarray(tensor))
+        if splits is None:
+            if tensor.shape[0] % self.topo.size != 0:
+                raise ValueError(
+                    f"alltoall first dim {tensor.shape[0]} not divisible by "
+                    f"size {self.topo.size}; pass explicit splits")
+            splits = [tensor.shape[0] // self.topo.size] * self.topo.size
+        entry = TensorTableEntry(tensor_name=name, tensor=tensor,
+                                 splits=list(splits), callback=callback,
+                                 request_type=RequestType.ALLTOALL)
+        req = Request(
+            request_rank=self.topo.rank, request_type=RequestType.ALLTOALL,
+            tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
+            tensor_shape=list(tensor.shape), splits=list(splits))
+        self.tensor_queue.add(entry, req)
+
+    def enqueue_join(self) -> threading.Event:
+        """Rank is done with its data: contribute zeros until everyone joins
+        (``EnqueueJoin``, ``operations.cc:1146-1170``)."""
+        self._check_initialized()
+        event = threading.Event()
+        if self.topo.size == 1:
+            event.set()
+            return event
+        self.joined = True
+        self.join_event = event
+        req = Request(request_rank=self.topo.rank, request_type=RequestType.JOIN,
+                      tensor_name=JOIN_TENSOR_NAME)
+        # JOIN carries no tensor entry; push the request directly.
+        self.tensor_queue.push_messages([req])
+        return event
+
+    def enqueue_barrier(self, callback: Callable[[Status], None],
+                        name: Optional[str] = None) -> None:
+        self._check_initialized()
+        name = name or BARRIER_TENSOR_NAME
+        entry = TensorTableEntry(tensor_name=name, callback=callback,
+                                 tensor=np.zeros(0, dtype=np.uint8),
+                                 request_type=RequestType.BARRIER)
+        req = Request(request_rank=self.topo.rank,
+                      request_type=RequestType.BARRIER, tensor_name=name)
+        self.tensor_queue.add(entry, req)
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Graceful global shutdown (``horovod_shutdown``,
+        ``operations.cc:752-778``)."""
+        if not self.initialized.is_set() or self.shutdown_complete.is_set():
+            return
+        self.shutdown_requested.set()
+        self.shutdown_complete.wait(timeout=60)
+        try:
+            atexit.unregister(self.shutdown)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def reset(self) -> None:
+        """Forget everything — used between elastic re-initializations and
+        by tests."""
+        self.shutdown()
+        self.__init__()  # type: ignore[misc]
+
+
+_global_state = HorovodGlobalState()
+
+
+def global_state() -> HorovodGlobalState:
+    return _global_state
+
+
+def reset_global_state() -> HorovodGlobalState:
+    global _global_state
+    _global_state.reset()
+    _global_state = HorovodGlobalState()
+    return _global_state

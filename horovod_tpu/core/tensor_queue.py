@@ -1,0 +1,118 @@
+"""TensorQueue — the hand-off point between framework threads and the
+background coordination thread.
+
+Role of the reference's ``horovod/common/tensor_queue.h:32-58`` /
+``tensor_queue.cc``: a mutex-guarded table of in-flight tensor entries plus a
+queue of pending Requests.  Framework threads add (entry, request) pairs; the
+background thread pops requests each cycle and later claims entries named by
+a negotiated Response.  Duplicate in-flight names are an error
+(``DUPLICATE_NAME_ERROR``, ``common.h:164-167``).
+
+Entries hold host-side numpy buffers.  The XLA data plane stages device
+arrays in/out of these buffers; keeping the queue numpy-only keeps the
+controller completely framework-agnostic.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..common.exceptions import DuplicateNameError
+from .messages import Request, RequestType, Response
+
+
+@dataclass
+class Status:
+    ok: bool = True
+    error_message: str = ""
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(True, "")
+
+    @staticmethod
+    def error(msg: str) -> "Status":
+        return Status(False, msg)
+
+
+@dataclass
+class TensorTableEntry:
+    """Reference ``TensorTableEntry`` (``common.h:238-261``)."""
+
+    tensor_name: str
+    tensor: Optional[np.ndarray] = None      # input buffer (None for joined)
+    output: Optional[np.ndarray] = None      # filled by the op
+    root_rank: int = -1
+    device: int = -1
+    request_type: RequestType = RequestType.ALLREDUCE
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    splits: Optional[List[int]] = None       # alltoall send splits
+    received_splits: Optional[List[int]] = None
+    # Called exactly once with (status, entry); entry.output holds the result.
+    callback: Callable = field(default=lambda status, entry: None)
+    # context fields used by the data plane to hand results back
+    context: dict = field(default_factory=dict)
+
+
+class TensorQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[str, TensorTableEntry] = {}
+        self._pending: List[Request] = []
+
+    def add(self, entry: TensorTableEntry, request: Request) -> None:
+        with self._lock:
+            if entry.tensor_name in self._table:
+                raise DuplicateNameError(
+                    f"tensor {entry.tensor_name!r} already in flight; collective "
+                    f"names must be unique until the previous op completes")
+            self._table[entry.tensor_name] = entry
+            self._pending.append(request)
+
+    def pop_messages(self) -> List[Request]:
+        """Drain pending requests (one cycle's worth) —
+        ``PopMessagesFromQueue`` (``tensor_queue.h:44``)."""
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
+
+    def push_messages(self, requests: List[Request]) -> None:
+        """Re-queue requests (cache-invalidation / retry path)."""
+        with self._lock:
+            self._pending = requests + self._pending
+
+    def get_entries_for_response(self, response: Response) -> List[TensorTableEntry]:
+        """Claim (remove) the entries a Response names.
+
+        For JOIN-substituted tensors absent from the table, the caller builds
+        zero entries from the response metadata instead (reference
+        ``GetTensorEntriesFromResponse`` zero-substitution,
+        ``tensor_queue.h:39-41``)."""
+        with self._lock:
+            entries = []
+            for name in response.tensor_names:
+                entry = self._table.pop(name, None)
+                if entry is not None:
+                    entries.append(entry)
+            return entries
+
+    def peek(self, name: str) -> Optional[TensorTableEntry]:
+        with self._lock:
+            return self._table.get(name)
+
+    def remove(self, name: str) -> Optional[TensorTableEntry]:
+        with self._lock:
+            return self._table.pop(name, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._table)

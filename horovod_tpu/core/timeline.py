@@ -1,0 +1,125 @@
+"""Chrome-tracing timeline — per-tensor lanes of negotiation + execution.
+
+Role of the reference's ``horovod/common/timeline.cc:1-509`` /
+``timeline.h:106-126``: a catapult-format JSON trace where each tensor gets
+its own lane (tid), showing ``NEGOTIATE_*`` (how long ranks waited on each
+other, with per-rank ready ticks) followed by the operation with nested
+activities.  The reference feeds records through a boost lockfree spsc queue
+drained by a writer thread so the background loop never blocks on disk; we
+use a ``SimpleQueue`` + writer thread for the same property.
+
+View the output in ``chrome://tracing`` / Perfetto.  Runtime toggles via
+``hvd.start_timeline()/stop_timeline()`` (reference ``operations.cc:780-806``)
+or the ``HOROVOD_TIMELINE`` env knob.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+_WRITER_SENTINEL = None
+
+
+class Timeline:
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._queue: queue.SimpleQueue = queue.SimpleQueue()
+        self._tids: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._start = time.monotonic_ns()
+        self._closed = False
+        self._file = open(path, "w", buffering=1024 * 1024)
+        self._file.write("[\n")
+        self._first = True
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="horovod-timeline", daemon=True)
+        self._writer.start()
+        self._emit({"name": "process_name", "ph": "M", "pid": 0,
+                    "args": {"name": "horovod_tpu background loop"}})
+
+    # -- producers (background/controller thread; never block) -------------
+
+    def _ts_us(self) -> float:
+        return (time.monotonic_ns() - self._start) / 1e3
+
+    def _tid(self, tensor_name: str) -> int:
+        with self._lock:
+            tid = self._tids.get(tensor_name)
+            if tid is None:
+                tid = len(self._tids) + 1
+                self._tids[tensor_name] = tid
+                self._emit({"name": "thread_name", "ph": "M", "pid": 0,
+                            "tid": tid, "args": {"name": tensor_name}})
+        return tid
+
+    def _emit(self, record: dict) -> None:
+        if not self._closed:
+            self._queue.put(record)
+
+    def negotiate_start(self, tensor_name: str, op_name: str) -> None:
+        self._emit({"name": f"NEGOTIATE_{op_name}", "ph": "B", "pid": 0,
+                    "tid": self._tid(tensor_name), "ts": self._ts_us()})
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
+        """Per-rank readiness tick inside the negotiation phase
+        (reference ``NegotiateRankReady``, ``timeline.h:113``)."""
+        self._emit({"name": str(rank), "ph": "i", "s": "t", "pid": 0,
+                    "tid": self._tid(tensor_name), "ts": self._ts_us()})
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        self._emit({"name": "", "ph": "E", "pid": 0,
+                    "tid": self._tid(tensor_name), "ts": self._ts_us()})
+
+    def op_start(self, response, entries) -> None:
+        name = response.response_type.name
+        ts = self._ts_us()
+        for e in entries:
+            self._emit({"name": name, "ph": "B", "pid": 0,
+                        "tid": self._tid(e.tensor_name), "ts": ts})
+
+    def op_end(self, response, entries) -> None:
+        ts = self._ts_us()
+        for e in entries:
+            self._emit({"name": "", "ph": "E", "pid": 0,
+                        "tid": self._tid(e.tensor_name), "ts": ts})
+
+    def activity(self, tensor_name: str, activity: str, begin: bool) -> None:
+        """Nested activity markers (MEMCPY_IN_FUSION_BUFFER, ... —
+        reference macro list ``common.h:31-62``)."""
+        rec = {"name": activity if begin else "", "ph": "B" if begin else "E",
+               "pid": 0, "tid": self._tid(tensor_name), "ts": self._ts_us()}
+        self._emit(rec)
+
+    def mark_cycle(self) -> None:
+        if self._mark_cycles:
+            self._emit({"name": "CYCLE", "ph": "i", "s": "g", "pid": 0,
+                        "tid": 0, "ts": self._ts_us()})
+
+    # -- writer thread ------------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            rec = self._queue.get()
+            if rec is _WRITER_SENTINEL:
+                break
+            try:
+                if not self._first:
+                    self._file.write(",\n")
+                self._first = False
+                self._file.write(json.dumps(rec))
+            except ValueError:  # file closed under us
+                break
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(_WRITER_SENTINEL)
+        self._writer.join(timeout=10)
+        self._file.write("\n]\n")
+        self._file.close()

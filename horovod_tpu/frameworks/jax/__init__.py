@@ -1,0 +1,39 @@
+"""jax binding — the default framework flavor.
+
+``import horovod_tpu as hvd`` resolves here: lifecycle, eager collectives,
+distributed optimizer and parameter/object broadcast utilities, mirroring
+the reference's ``horovod.torch``/``horovod.tensorflow`` surfaces
+(``torch/__init__.py``, ``tensorflow/__init__.py``).
+"""
+
+from .basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+)
+from .ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    join,
+    poll,
+    synchronize,
+)

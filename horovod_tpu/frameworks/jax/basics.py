@@ -1,0 +1,91 @@
+"""Lifecycle + topology queries.
+
+Role of the reference's ``horovod/common/basics.py:25-258`` (``HorovodBasics``:
+the ctypes bridge to ``horovod_init/_shutdown/_rank/_size/...``,
+``operations.cc:750-938``).  No ctypes needed here — the runtime is
+in-process — but the API surface and semantics match.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...common.exceptions import HorovodInternalError
+from ...common.topology import ProcessTopology
+from ...core.state import global_state, reset_global_state
+from ...transport.store import Store
+
+
+def init(store: Optional[Store] = None,
+         topology: Optional[ProcessTopology] = None) -> None:
+    """Initialize the runtime: topology from the launcher env (or given
+    explicitly), TCP mesh rendezvous when size > 1, background thread up.
+
+    Reference: ``hvd.init()`` → ``horovod_init`` (``operations.cc:752``)."""
+    global_state().initialize(store=store, topology=topology)
+
+
+def shutdown() -> None:
+    global_state().shutdown()
+
+
+def is_initialized() -> bool:
+    return global_state().initialized.is_set()
+
+
+def _topo() -> ProcessTopology:
+    state = global_state()
+    if not state.initialized.is_set() or state.topo is None:
+        raise HorovodInternalError(
+            "horovod_tpu has not been initialized; call hvd.init() first.")
+    return state.topo
+
+
+def rank() -> int:
+    return _topo().rank
+
+
+def size() -> int:
+    return _topo().size
+
+
+def local_rank() -> int:
+    return _topo().local_rank
+
+
+def local_size() -> int:
+    return _topo().local_size
+
+
+def cross_rank() -> int:
+    return _topo().cross_rank
+
+
+def cross_size() -> int:
+    return _topo().cross_size
+
+
+def is_homogeneous() -> bool:
+    return _topo().is_homogeneous
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Runtime-togglable timeline (reference ``operations.cc:780-806``)."""
+    from ...core.timeline import Timeline
+
+    state = global_state()
+    state.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+    if state.controller is not None:
+        state.controller.timeline = state.timeline
+
+
+def stop_timeline() -> None:
+    state = global_state()
+    if state.timeline is not None:
+        state.timeline.close()
+        state.timeline = None
+
+
+def _internal_reset() -> None:
+    """Full teardown + fresh state (elastic re-init path and tests)."""
+    reset_global_state()

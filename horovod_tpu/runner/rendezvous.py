@@ -1,0 +1,145 @@
+"""Threaded HTTP KV rendezvous server — the launcher-side meeting point.
+
+Role of the reference's ``horovod/runner/http/http_server.py:1-241``
+(``RendezvousServer``): a tiny threaded HTTP key-value store the launcher
+starts before spawning workers.  Workers publish/fetch TCP endpoints through
+it (``transport.store.HTTPStoreClient``), the elastic driver publishes slot
+assignments into a well-known scope, and DELETE doubles as the
+worker-finalized notification hook.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import unquote
+
+RANK_AND_SIZE_SCOPE = "rank_and_size"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # quiet by default
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _parse(self) -> Optional[Tuple[str, str]]:
+        parts = [unquote(p) for p in self.path.split("/") if p]
+        if len(parts) != 2:
+            self.send_error(400, "expected /scope/key")
+            return None
+        return parts[0], parts[1]
+
+    def do_PUT(self):
+        parsed = self._parse()
+        if parsed is None:
+            return
+        scope, key = parsed
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        self.server.store_set(scope, key, body)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        parsed = self._parse()
+        if parsed is None:
+            return
+        scope, key = parsed
+        val = self.server.store_get(scope, key)
+        if val is None:
+            self.send_error(404, "no such key")
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_DELETE(self):
+        parsed = self._parse()
+        if parsed is None:
+            return
+        scope, key = parsed
+        existed = self.server.store_delete(scope, key)
+        self.send_response(200 if existed else 404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class _KVServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, delete_hook=None):
+        super().__init__(addr, _Handler)
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._delete_hook = delete_hook
+
+    def store_set(self, scope: str, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[f"{scope}/{key}"] = value
+
+    def store_get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(f"{scope}/{key}")
+
+    def store_delete(self, scope: str, key: str) -> bool:
+        with self._lock:
+            existed = self._data.pop(f"{scope}/{key}", None) is not None
+        if existed and self._delete_hook is not None:
+            self._delete_hook(scope, key)
+        return existed
+
+
+class RendezvousServer:
+    """Launcher-side KV server; start() returns the bound port."""
+
+    def __init__(self, bind_addr: str = "0.0.0.0",
+                 delete_hook: Optional[Callable[[str, str], None]] = None):
+        self._bind_addr = bind_addr
+        self._server: Optional[_KVServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._delete_hook = delete_hook
+
+    def start(self, port: int = 0) -> int:
+        self._server = _KVServer((self._bind_addr, port), self._delete_hook)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rendezvous-http", daemon=True)
+        self._thread.start()
+        return self._server.server_address[1]
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "not started"
+        return self._server.server_address[1]
+
+    def publish_slots(self, slots: List[dict]) -> None:
+        """Publish the slot table (rank/local/cross per slot) for elastic
+        re-rendezvous — reference publishes the host-alloc plan the same way
+        (``http_server.py`` init / ``gloo_context.cc:154-189`` reads it)."""
+        assert self._server is not None
+        import json
+
+        for slot in slots:
+            self._server.store_set(
+                RANK_AND_SIZE_SCOPE,
+                f"{slot['hostname']}:{slot['local_rank']}",
+                json.dumps(slot).encode())
+
+    def set(self, scope: str, key: str, value: bytes) -> None:
+        assert self._server is not None
+        self._server.store_set(scope, key, value)
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        assert self._server is not None
+        return self._server.store_get(scope, key)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,2 @@
+from .store import HTTPStoreClient, MemoryStore, Store  # noqa: F401
+from .tcp import TcpMesh  # noqa: F401

@@ -1,0 +1,119 @@
+"""Key-value rendezvous stores.
+
+Role of the reference's ``gloo::rendezvous::Store`` implementations:
+``HTTPStore`` (``horovod/common/gloo/http_store.cc:1-138``) lets C++ workers
+rendezvous through the launcher's HTTP KV server with scope-prefixed
+GET/PUT/DELETE, and ``MemoryStore`` (``gloo/memory_store.cc``) serves the
+single-process case.  Ours are Python: the TCP mesh transport uses a Store to
+exchange listen addresses, and the elastic path uses it for rank
+reassignment.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+
+class Store:
+    """Abstract scope-prefixed KV store with blocking waits."""
+
+    def set(self, scope: str, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        """Non-blocking read; None when absent."""
+        raise NotImplementedError
+
+    def delete(self, scope: str, key: str) -> None:
+        raise NotImplementedError
+
+    def wait(self, scope: str, keys: List[str], timeout: float = 60.0) -> Dict[str, bytes]:
+        """Block until every key exists; returns the values.
+
+        Reference analog: ``gloo::rendezvous::Store::wait`` used during
+        full-mesh connect (``gloo_context.cc:63-84``)."""
+        deadline = time.monotonic() + timeout
+        out: Dict[str, bytes] = {}
+        pending = list(keys)
+        while pending:
+            still = []
+            for k in pending:
+                v = self.get(scope, k)
+                if v is None:
+                    still.append(k)
+                else:
+                    out[k] = v
+            pending = still
+            if pending:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"store wait timed out after {timeout}s for keys {pending} "
+                        f"in scope {scope!r}")
+                time.sleep(0.01)
+        return out
+
+
+class MemoryStore(Store):
+    """In-process store for single-process jobs and unit tests."""
+
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+
+    def set(self, scope: str, key: str, value: bytes) -> None:
+        with self._cv:
+            self._data[f"{scope}/{key}"] = value
+            self._cv.notify_all()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._cv:
+            return self._data.get(f"{scope}/{key}")
+
+    def delete(self, scope: str, key: str) -> None:
+        with self._cv:
+            self._data.pop(f"{scope}/{key}", None)
+
+
+class HTTPStoreClient(Store):
+    """Client for the launcher's rendezvous HTTP KV server.
+
+    Wire contract (shared with ``horovod_tpu.runner.rendezvous``):
+    ``PUT /scope/key`` stores the body; ``GET /scope/key`` returns 200+body or
+    404; ``DELETE /scope/key`` removes (and serves as the worker-finalized
+    hook, reference ``runner/http/http_server.py:112-133``)."""
+
+    def __init__(self, addr: str, port: int, timeout: float = 30.0):
+        self._base = f"http://{addr}:{port}"
+        self._timeout = timeout
+
+    def _url(self, scope: str, key: str) -> str:
+        return f"{self._base}/{urllib.parse.quote(scope)}/{urllib.parse.quote(key)}"
+
+    def set(self, scope: str, key: str, value: bytes) -> None:
+        req = urllib.request.Request(self._url(scope, key), data=value, method="PUT")
+        with urllib.request.urlopen(req, timeout=self._timeout):
+            pass
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        req = urllib.request.Request(self._url(scope, key), method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete(self, scope: str, key: str) -> None:
+        req = urllib.request.Request(self._url(scope, key), method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise

@@ -1,0 +1,205 @@
+"""Full-mesh TCP transport — the self-contained Gloo role.
+
+The reference leans on libgloo for its MPI-free path: every rank builds TCP
+connections to every other rank through a rendezvous store
+(``gloo_context.cc:63-84`` ``connectFullMesh``) and the controller/data ops
+run over those sockets.  We are MPI- and gloo-free by design (north star), so
+this module is that fabric: a framed, thread-safe, full-mesh TCP transport
+bootstrapped through a ``Store``.
+
+Framing: 4-byte little-endian length + payload.  Connection establishment is
+deterministic to avoid crossed sockets: every rank listens; rank *i* dials
+every rank *j < i* and introduces itself with an 8-byte hello (magic + rank).
+
+Only the background/controller thread performs transport I/O in steady state,
+but sends and recvs are independently locked per peer so the elastic
+notification path can interleave safely.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common.exceptions import HorovodInternalError
+from .store import Store
+
+_HELLO = struct.pack("<I", 0x48564D54)  # "HVMT"
+_LEN = struct.Struct("<Q")
+
+
+class _Peer:
+    __slots__ = ("sock", "send_lock", "recv_lock")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.recv_lock = threading.Lock()
+
+
+class TcpMesh:
+    """Framed full-mesh TCP fabric between ``size`` ranks."""
+
+    def __init__(self, rank: int, size: int, store: Store,
+                 scope: str = "tcp", bind_addr: str = "0.0.0.0",
+                 advertise_addr: Optional[str] = None,
+                 timeout: float = 60.0):
+        self.rank = rank
+        self.size = size
+        self._peers: Dict[int, _Peer] = {}
+        self._closed = False
+        if size == 1:
+            self._listener = None
+            return
+
+        self._listener = socket.create_server((bind_addr, 0), backlog=size)
+        port = self._listener.getsockname()[1]
+        if advertise_addr is None:
+            advertise_addr = _default_advertise_addr()
+        store.set(scope, str(rank), f"{advertise_addr}:{port}".encode())
+
+        # Accept connections from higher ranks while dialing lower ranks.
+        accept_err: List[BaseException] = []
+        n_expected = size - 1 - rank
+        acceptor = threading.Thread(
+            target=self._accept_loop, args=(n_expected, accept_err, timeout),
+            daemon=True)
+        acceptor.start()
+
+        lower = [str(j) for j in range(rank)]
+        addrs = store.wait(scope, lower, timeout=timeout) if lower else {}
+        for j in range(rank):
+            host, p = addrs[str(j)].decode().rsplit(":", 1)
+            sock = _dial(host, int(p), timeout)
+            sock.sendall(_HELLO + struct.pack("<I", rank))
+            self._peers[j] = _Peer(sock)
+
+        acceptor.join(timeout=timeout)
+        if accept_err:
+            raise HorovodInternalError(f"tcp mesh accept failed: {accept_err[0]}")
+        if len(self._peers) != size - 1:
+            raise HorovodInternalError(
+                f"tcp mesh incomplete: have {len(self._peers)}/{size - 1} peers")
+
+    def _accept_loop(self, n_expected: int, err: List[BaseException],
+                     timeout: float) -> None:
+        try:
+            self._listener.settimeout(timeout)
+            for _ in range(n_expected):
+                sock, _ = self._listener.accept()
+                _configure(sock)
+                hello = _recv_exact(sock, 8)
+                if hello[:4] != _HELLO:
+                    raise HorovodInternalError("bad tcp mesh hello")
+                peer_rank = struct.unpack("<I", hello[4:])[0]
+                self._peers[peer_rank] = _Peer(sock)
+        except BaseException as e:  # surfaced by constructor
+            err.append(e)
+
+    # -- framed messaging ---------------------------------------------------
+
+    def send(self, peer: int, payload: bytes) -> None:
+        p = self._peer(peer)
+        with p.send_lock:
+            try:
+                p.sock.sendall(_LEN.pack(len(payload)))
+                p.sock.sendall(payload)
+            except OSError as e:
+                raise HorovodInternalError(f"send to rank {peer} failed: {e}") from e
+
+    def recv(self, peer: int) -> bytes:
+        p = self._peer(peer)
+        with p.recv_lock:
+            try:
+                n = _LEN.unpack(_recv_exact(p.sock, _LEN.size))[0]
+                return _recv_exact(p.sock, n)
+            except OSError as e:
+                raise HorovodInternalError(f"recv from rank {peer} failed: {e}") from e
+
+    def sendrecv(self, send_to: int, payload: bytes, recv_from: int) -> bytes:
+        """Concurrent send+recv — the ring-collective step primitive.
+
+        A sequential send-then-recv deadlocks on rings once payloads exceed
+        socket buffers (everyone blocked in sendall); overlap them."""
+        out: List[bytes] = []
+        err: List[BaseException] = []
+
+        def _recv():
+            try:
+                out.append(self.recv(recv_from))
+            except BaseException as e:
+                err.append(e)
+
+        t = threading.Thread(target=_recv, daemon=True)
+        t.start()
+        self.send(send_to, payload)
+        t.join()
+        if err:
+            raise err[0]
+        return out[0]
+
+    def _peer(self, peer: int) -> _Peer:
+        try:
+            return self._peers[peer]
+        except KeyError:
+            raise HorovodInternalError(
+                f"rank {self.rank} has no connection to rank {peer}") from None
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._listener is not None:
+            self._listener.close()
+        for p in self._peers.values():
+            try:
+                p.sock.close()
+            except OSError:
+                pass
+
+
+def _default_advertise_addr() -> str:
+    # Best-effort routable address; loopback fallback for single-host jobs.
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def _dial(host: str, port: int, timeout: float) -> socket.socket:
+    deadline = time.monotonic() + timeout
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection((host, port), timeout=timeout)
+            _configure(sock)
+            return sock
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise HorovodInternalError(f"could not connect to {host}:{port}: {last}")
+
+
+def _configure(sock: socket.socket) -> None:
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise HorovodInternalError("peer closed connection")
+        got += r
+    return bytes(buf)

@@ -1,0 +1,84 @@
+"""Multi-process test harness.
+
+Reference analog: the parallel test tier runs every test body under a real
+2+-process launcher (``.buildkite/gen-pipeline.sh:96-114`` —
+``mpirun -np 2 pytest ...``).  We invert it: the test process plays launcher
+(rendezvous server + env + subprocess spawn), each worker runs a script body
+against the real runtime, and the test asserts on worker stdout/exit codes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PREAMBLE = """
+import os, sys
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+"""
+
+EPILOGUE = """
+hvd.shutdown()
+print("WORKER_OK", rank)
+"""
+
+
+def run_distributed(n: int, body: str, timeout: float = 120,
+                    extra_env: Optional[Dict[str, str]] = None,
+                    expect_failure: bool = False) -> List[str]:
+    """Run `body` on n worker processes; returns per-rank stdout."""
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    server = RendezvousServer(bind_addr="127.0.0.1")
+    port = server.start()
+    script = PREAMBLE + body + ("" if expect_failure else EPILOGUE)
+    procs = []
+    try:
+        for r in range(n):
+            env = os.environ.copy()
+            env.update({
+                "HOROVOD_RANK": str(r),
+                "HOROVOD_SIZE": str(n),
+                "HOROVOD_LOCAL_RANK": str(r),
+                "HOROVOD_LOCAL_SIZE": str(n),
+                "HOROVOD_CROSS_RANK": "0",
+                "HOROVOD_CROSS_SIZE": "1",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+            })
+            env.update(extra_env or {})
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script],
+                env=env, cwd=REPO_ROOT, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        outs, errs, codes = [], [], []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, err = p.communicate()
+                raise AssertionError(
+                    f"worker timed out after {timeout}s\nstdout:\n{out}\nstderr:\n{err}")
+            outs.append(out)
+            errs.append(err)
+            codes.append(p.returncode)
+        if not expect_failure:
+            for r, (code, out, err) in enumerate(zip(codes, outs, errs)):
+                assert code == 0 and f"WORKER_OK {r}" in out, (
+                    f"rank {r} failed (exit {code})\nstdout:\n{out}\nstderr:\n{err}")
+        return outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

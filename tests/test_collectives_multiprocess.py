@@ -1,0 +1,158 @@
+"""End-to-end multi-process collective tests — the parallel tier.
+
+Modeled on the reference's ``test/parallel/test_torch.py`` /
+``test_tensorflow.py`` structure: rank-dependent inputs so wrong-rank bugs
+change results; closed-form expectations; error-path tests for cross-rank
+mismatches (reference ``test_tensorflow.py:603-673``)."""
+
+import pytest
+
+from .helpers import run_distributed
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_allreduce_average(n):
+    run_distributed(n, """
+x = np.arange(8, dtype=np.float32) * (rank + 1)
+out = hvd.allreduce(x, average=True, name="avg0")
+expected = np.arange(8, dtype=np.float32) * (sum(r + 1 for r in range(size)) / size)
+np.testing.assert_allclose(out, expected, rtol=1e-6)
+""")
+
+
+def test_allreduce_sum_and_scales():
+    run_distributed(2, """
+x = np.ones(5, dtype=np.float64) * (rank + 1)
+out = hvd.allreduce(x, op=hvd.Sum, name="sum0")
+np.testing.assert_allclose(out, np.ones(5) * 3.0)
+
+out = hvd.allreduce(x, op=hvd.Sum, name="scaled",
+                    prescale_factor=2.0, postscale_factor=0.5)
+np.testing.assert_allclose(out, np.ones(5) * 3.0)
+""")
+
+
+def test_allreduce_fused_many_tensors():
+    # several tensors in flight at once — exercises controller fusion
+    run_distributed(2, """
+handles = [hvd.allreduce_async(np.full(100, float(i + rank), np.float32),
+                               op=hvd.Sum, name=f"t{i}") for i in range(10)]
+for i, h in enumerate(handles):
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(out, np.full(100, float(2 * i + 1), np.float32))
+""")
+
+
+def test_allreduce_bfloat16():
+    run_distributed(2, """
+import ml_dtypes
+x = (np.arange(16) % 8).astype(ml_dtypes.bfloat16) * (rank + 1)
+out = hvd.allreduce(x, op=hvd.Sum, name="bf16")
+expected = ((np.arange(16) % 8) * 3).astype(ml_dtypes.bfloat16)
+assert out.dtype == x.dtype
+np.testing.assert_allclose(out.astype(np.float32), expected.astype(np.float32))
+""")
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_allgather_variable_size(n):
+    run_distributed(n, """
+x = np.full((rank + 1, 3), float(rank), np.float32)
+out = hvd.allgather(x, name="ag")
+assert out.shape == (sum(r + 1 for r in range(size)), 3)
+offset = 0
+for r in range(size):
+    np.testing.assert_allclose(out[offset:offset + r + 1], float(r))
+    offset += r + 1
+""")
+
+
+def test_broadcast_from_nonzero_root():
+    run_distributed(3, """
+x = np.arange(6, dtype=np.int64) * (rank + 10)
+out = hvd.broadcast(x, root_rank=1, name="bc")
+np.testing.assert_array_equal(out, np.arange(6, dtype=np.int64) * 11)
+""")
+
+
+def test_alltoall_uneven_splits():
+    run_distributed(2, """
+# rank 0 sends [1 row to r0, 2 rows to r1]; rank 1 sends [3 rows to r0, 1 to r1]
+splits = [[1, 2], [3, 1]][rank]
+rows = sum(splits)
+x = np.full((rows, 2), float(rank), np.float32)
+out, rsplits = hvd.alltoall(x, splits=splits, name="a2a",
+                            return_received_splits=True)
+expected_rsplits = [[1, 3], [2, 1]][rank]
+assert rsplits == expected_rsplits, (rsplits, expected_rsplits)
+assert out.shape == (sum(expected_rsplits), 2)
+offset = 0
+for r, cnt in enumerate(expected_rsplits):
+    np.testing.assert_allclose(out[offset:offset + cnt], float(r))
+    offset += cnt
+""")
+
+
+def test_shape_mismatch_raises_everywhere():
+    run_distributed(2, """
+from horovod_tpu.common.exceptions import HorovodInternalError
+x = np.ones(3 + rank, np.float32)  # different shapes
+try:
+    hvd.allreduce(x, name="bad_shape")
+    raise SystemExit("expected HorovodInternalError")
+except HorovodInternalError as e:
+    assert "shape" in str(e).lower(), str(e)
+# runtime must still be healthy afterwards
+out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="after_err")
+np.testing.assert_allclose(out, 2 * np.ones(4))
+""")
+
+
+def test_dtype_mismatch_raises():
+    run_distributed(2, """
+from horovod_tpu.common.exceptions import HorovodInternalError
+x = np.ones(4, np.float32 if rank == 0 else np.float64)
+try:
+    hvd.allreduce(x, name="bad_dtype")
+    raise SystemExit("expected HorovodInternalError")
+except HorovodInternalError as e:
+    assert "data type" in str(e).lower().replace("dtype", "data type"), str(e)
+""")
+
+
+def test_join_uneven_steps():
+    # rank r performs (r+1) allreduces, then joins; joined ranks contribute
+    # zeros (reference Join semantics, collective_operations.cc:257)
+    run_distributed(3, """
+for i in range(rank + 1):
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name=f"step{i}")
+    # ranks still active at step i: those with r >= i → size - i
+    expected = float(size - i)
+    np.testing.assert_allclose(out, expected)
+hvd.join()
+""")
+
+
+def test_barrier_and_duplicate_names():
+    run_distributed(2, """
+from horovod_tpu.common.exceptions import DuplicateNameError
+hvd.barrier(name="b1")
+h1 = hvd.allreduce_async(np.ones(1000000, np.float32), name="dup")
+try:
+    hvd.allreduce_async(np.ones(4, np.float32), name="dup")
+    raise SystemExit("expected DuplicateNameError")
+except DuplicateNameError:
+    pass
+hvd.synchronize(h1)
+""")
+
+
+def test_jax_array_roundtrip():
+    run_distributed(2, """
+import jax.numpy as jnp
+import jax
+x = jnp.arange(8, dtype=jnp.float32) * (rank + 1)
+out = hvd.allreduce(x, op=hvd.Sum, name="jax0")
+assert isinstance(out, jax.Array)
+np.testing.assert_allclose(np.asarray(out), np.arange(8) * 3.0)
+""")

@@ -1,0 +1,100 @@
+"""Timeline output + error-path regression tests (reference analogs:
+``test/integration/test_timeline.py`` and the review findings on init retry,
+handle leaks, and broadcast-under-join)."""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from .helpers import run_distributed
+
+
+def test_timeline_written_and_parseable(tmp_path):
+    tl = tmp_path / "timeline.json"
+    run_distributed(2, """
+for i in range(3):
+    hvd.allreduce(np.ones(16, np.float32), name=f"t{i}")
+hvd.allgather(np.ones(2, np.float32), name="g0")
+""", extra_env={"HOROVOD_TIMELINE": str(tl),
+                "HOROVOD_TIMELINE_MARK_CYCLES": "1"})
+    events = json.loads(tl.read_text())
+    names = {e.get("args", {}).get("name") for e in events if e.get("ph") == "M"}
+    assert {"t0", "t1", "t2", "g0"} <= names
+    phases = {e["name"] for e in events if e.get("ph") == "B"}
+    assert any(p.startswith("NEGOTIATE_ALLREDUCE") for p in phases)
+    assert "ALLREDUCE" in phases and "ALLGATHER" in phases
+    assert any(e.get("name") == "CYCLE" for e in events)
+
+
+def test_init_failure_is_retryable(monkeypatch):
+    """A failed init (bad rendezvous) must not brick the process."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.core import state as state_mod
+
+    state_mod.reset_global_state()
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    monkeypatch.delenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", raising=False)
+    import horovod_tpu.frameworks.jax.basics as basics
+
+    with pytest.raises(HorovodInternalError):
+        basics.init()
+    assert not basics.is_initialized()
+    # retry as a single-process job succeeds
+    monkeypatch.setenv("HOROVOD_SIZE", "1")
+    basics.init()
+    assert basics.is_initialized()
+    import horovod_tpu.frameworks.jax.ops as ops
+
+    out = ops.allreduce(np.arange(4.0, dtype=np.float32), name="retry_ok")
+    np.testing.assert_allclose(out, np.arange(4.0))
+    state_mod.global_state().shutdown()
+    state_mod.reset_global_state()
+
+
+def test_failed_enqueue_releases_handle():
+    from horovod_tpu.common.exceptions import DuplicateNameError
+    from horovod_tpu.core import state as state_mod
+
+    state_mod.reset_global_state()
+    os.environ.pop("HOROVOD_SIZE", None)
+    import horovod_tpu.frameworks.jax.basics as basics
+    import horovod_tpu.frameworks.jax.ops as ops
+
+    basics.init()
+    try:
+        # block completion by never cycling? size=1 completes fast; use a
+        # name collision window instead: enqueue two with same name quickly.
+        before = len(ops._handles._events)
+        h = ops.allreduce_async(np.ones(4, np.float32), name="leak_check")
+        try:
+            while True:
+                ops.allreduce_async(np.ones(4, np.float32), name="leak_check")
+        except DuplicateNameError:
+            pass
+        except Exception:
+            pass  # completed before the second enqueue — fine either way
+        ops.synchronize(h)
+        # no leaked events beyond the in-flight ones we resolved
+        assert len(ops._handles._events) <= before + 1
+    finally:
+        state_mod.global_state().shutdown()
+        state_mod.reset_global_state()
+
+
+def test_broadcast_with_joined_rank_errors():
+    run_distributed(2, """
+from horovod_tpu.common.exceptions import HorovodInternalError
+if rank == 1:
+    hvd.join()
+else:
+    try:
+        hvd.broadcast(np.ones(4, np.float32), root_rank=0, name="bc_join")
+        raise SystemExit("expected HorovodInternalError")
+    except HorovodInternalError as e:
+        assert "joined" in str(e).lower(), str(e)
+    hvd.join()
+""")

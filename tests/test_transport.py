@@ -1,0 +1,163 @@
+"""Transport-layer tests: KV stores, rendezvous HTTP server, TCP mesh.
+
+The mesh tests run N ranks as threads inside one process sharing a
+MemoryStore / live HTTP server — the transport doesn't care, which is the
+point (reference analog: gloo connectFullMesh through any Store)."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.runner.rendezvous import RendezvousServer
+from horovod_tpu.transport import HTTPStoreClient, MemoryStore, TcpMesh
+
+
+def run_ranks(size, fn, timeout=30):
+    """Run fn(rank) on `size` threads; re-raise the first failure."""
+    errs = []
+    results = [None] * size
+
+    def wrap(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001
+            errs.append((r, e))
+
+    threads = [threading.Thread(target=wrap, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "rank thread hung"
+    if errs:
+        raise errs[0][1]
+    return results
+
+
+def test_memory_store_wait():
+    store = MemoryStore()
+    store.set("s", "a", b"1")
+
+    def delayed():
+        store.set("s", "b", b"2")
+
+    threading.Timer(0.05, delayed).start()
+    got = store.wait("s", ["a", "b"], timeout=5)
+    assert got == {"a": b"1", "b": b"2"}
+
+
+def test_memory_store_wait_timeout():
+    store = MemoryStore()
+    with pytest.raises(TimeoutError):
+        store.wait("s", ["missing"], timeout=0.1)
+
+
+def test_http_store_roundtrip():
+    server = RendezvousServer(bind_addr="127.0.0.1")
+    port = server.start()
+    try:
+        client = HTTPStoreClient("127.0.0.1", port)
+        assert client.get("scope", "k") is None
+        client.set("scope", "k", b"\x00\x01binary\xff")
+        assert client.get("scope", "k") == b"\x00\x01binary\xff"
+        client.delete("scope", "k")
+        assert client.get("scope", "k") is None
+        client.delete("scope", "k")  # idempotent
+        # scoping: same key name, different scope
+        client.set("a", "k", b"1")
+        client.set("b", "k", b"2")
+        assert client.get("a", "k") == b"1"
+        assert client.get("b", "k") == b"2"
+    finally:
+        server.stop()
+
+
+def test_http_store_wait_across_clients():
+    server = RendezvousServer(bind_addr="127.0.0.1")
+    port = server.start()
+    try:
+        c1 = HTTPStoreClient("127.0.0.1", port)
+        c2 = HTTPStoreClient("127.0.0.1", port)
+        threading.Timer(0.05, lambda: c2.set("s", "x", b"hello")).start()
+        got = c1.wait("s", ["x"], timeout=5)
+        assert got["x"] == b"hello"
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_tcp_mesh_pairwise(size):
+    store = MemoryStore()
+
+    def fn(rank):
+        mesh = TcpMesh(rank, size, store, bind_addr="127.0.0.1",
+                       advertise_addr="127.0.0.1", timeout=10)
+        try:
+            # everyone sends its rank to everyone else
+            for peer in range(size):
+                if peer != rank:
+                    mesh.send(peer, f"from-{rank}".encode())
+            got = {}
+            for peer in range(size):
+                if peer != rank:
+                    got[peer] = mesh.recv(peer).decode()
+            return got
+        finally:
+            mesh.close()
+
+    results = run_ranks(size, fn)
+    for rank, got in enumerate(results):
+        assert got == {p: f"from-{p}" for p in range(size) if p != rank}
+
+
+def test_tcp_mesh_large_payload_ring():
+    """Ring exchange with payloads larger than socket buffers must not
+    deadlock (sendrecv overlaps directions)."""
+    size = 3
+    store = MemoryStore()
+    payload = b"x" * (8 * 1024 * 1024)
+
+    def fn(rank):
+        mesh = TcpMesh(rank, size, store, bind_addr="127.0.0.1",
+                       advertise_addr="127.0.0.1", timeout=10)
+        try:
+            nxt, prv = (rank + 1) % size, (rank - 1) % size
+            got = mesh.sendrecv(nxt, payload, prv)
+            assert got == payload
+            return True
+        finally:
+            mesh.close()
+
+    assert all(run_ranks(size, fn, timeout=60))
+
+
+def test_tcp_mesh_size_one_noop():
+    mesh = TcpMesh(0, 1, MemoryStore())
+    with pytest.raises(Exception):
+        mesh.send(1, b"nope")
+    mesh.close()
+
+
+def test_tcp_mesh_over_http_store():
+    server = RendezvousServer(bind_addr="127.0.0.1")
+    port = server.start()
+    try:
+        def fn(rank):
+            client = HTTPStoreClient("127.0.0.1", port)
+            mesh = TcpMesh(rank, 2, client, bind_addr="127.0.0.1",
+                           advertise_addr="127.0.0.1", timeout=10)
+            try:
+                if rank == 0:
+                    mesh.send(1, b"ping")
+                    assert mesh.recv(1) == b"pong"
+                else:
+                    assert mesh.recv(0) == b"ping"
+                    mesh.send(0, b"pong")
+                return True
+            finally:
+                mesh.close()
+
+        assert all(run_ranks(2, fn))
+    finally:
+        server.stop()
