@@ -167,6 +167,11 @@ class HorovodGlobalState:
         except BaseException as e:  # noqa: BLE001
             log.error("background loop died: %s", e, exc_info=True)
             self._fail_all_pending(str(e))
+        else:
+            # Clean shutdown must also unblock waiters: entries that never
+            # negotiated get SHUT_DOWN_ERROR-style callbacks, like the
+            # reference draining the tensor table on shutdown.
+            self._fail_all_pending("Horovod has been shut down")
         finally:
             if self.mesh is not None:
                 self.mesh.close()

@@ -121,5 +121,10 @@ class Timeline:
         self._closed = True
         self._queue.put(_WRITER_SENTINEL)
         self._writer.join(timeout=10)
+        if self._writer.is_alive():
+            # Writer still draining a deep backlog: do not write the epilogue
+            # or close the file under it — a truncated-but-valid-prefix trace
+            # beats an interleaved corrupt one.
+            return
         self._file.write("\n]\n")
         self._file.close()

@@ -70,10 +70,18 @@ def is_homogeneous() -> bool:
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
-    """Runtime-togglable timeline (reference ``operations.cc:780-806``)."""
+    """Runtime-togglable timeline (reference ``operations.cc:780-806``).
+
+    Like the env-var path, the trace is written only on the coordinator
+    (rank 0, reference ``operations.cc:424-432``); on other ranks this is a
+    no-op so ranks sharing a filesystem don't clobber one file."""
     from ...core.timeline import Timeline
 
     state = global_state()
+    if state.topo is not None and state.topo.rank != 0:
+        return
+    if state.timeline is not None:
+        state.timeline.close()
     state.timeline = Timeline(file_path, mark_cycles=mark_cycles)
     if state.controller is not None:
         state.controller.timeline = state.timeline
@@ -84,6 +92,8 @@ def stop_timeline() -> None:
     if state.timeline is not None:
         state.timeline.close()
         state.timeline = None
+    if state.controller is not None:
+        state.controller.timeline = None
 
 
 def _internal_reset() -> None:

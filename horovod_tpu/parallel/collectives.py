@@ -1,0 +1,125 @@
+"""jit-path collectives: XLA equivalents of the reference op chain.
+
+The reference dispatches each fused Response through an ordered chain of
+backend ops (`operation_manager.cc:41-49`; NCCL/MPI/Gloo implementations in
+SURVEY §2.3).  Inside ``jit``/``shard_map`` those backends are replaced by a
+single "backend": XLA emits the collective HLO and the TPU runtime executes
+it over ICI/DCN.  These wrappers exist so framework code names *operations*
+(allreduce/allgather/...) rather than lax primitives, mirroring the
+reference API surface (`hvd.allreduce` etc.) on the compiled path.
+
+All functions must be called inside ``shard_map`` (or a jit with manual
+axes) where ``axis_name`` is bound.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def allreduce(x: jax.Array, axis_name: AxisNames, op: str = "sum",
+              prescale_factor: Optional[float] = None,
+              postscale_factor: Optional[float] = None) -> jax.Array:
+    """Sum/average/min/max allreduce.
+
+    Average is postscale-by-1/size exactly like the reference
+    (`operations.cc:953-956`); pre/postscale mirror the wire fields
+    (`message.h:48-113`).
+    """
+    if prescale_factor is not None:
+        x = x * prescale_factor
+    if op in ("sum", "average", "mean"):
+        out = lax.psum(x, axis_name)
+        if op in ("average", "mean"):
+            out = out / axis_size(axis_name)
+    elif op == "min":
+        out = lax.pmin(x, axis_name)
+    elif op == "max":
+        out = lax.pmax(x, axis_name)
+    else:
+        raise ValueError(f"unsupported reduce op {op!r}")
+    if postscale_factor is not None:
+        out = out * postscale_factor
+    return out
+
+
+def allgather(x: jax.Array, axis_name: AxisNames, axis: int = 0,
+              tiled: bool = True) -> jax.Array:
+    """Concatenate shards along ``axis`` (reference `MPIAllgather`,
+    `mpi_operations.cc:97`; variable first-dim gathers are the eager path's
+    job — compiled shapes are static)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis_name: AxisNames, axis: int = 0) -> jax.Array:
+    """psum then keep this rank's shard — the building block of the
+    reference's hierarchical allreduce (`nccl_operations.cc:194-405`,
+    ncclReduceScatter leg)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x: jax.Array, axis_name: AxisNames, root: int = 0) -> jax.Array:
+    """Every member gets root's value (reference `MPIBroadcast`,
+    `mpi_operations.cc:358`).  Implemented as masked psum — a one-hot
+    select then sum, which XLA lowers to an efficient broadcast."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def alltoall(x: jax.Array, axis_name: AxisNames,
+             split_axis: int = 0, concat_axis: int = 0) -> jax.Array:
+    """Even alltoall (reference `MPIAlltoall`, `mpi_operations.cc:393`).
+    Uneven splits belong to the eager path; XLA shapes are static."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute_ring(x: jax.Array, axis_name: AxisNames, shift: int = 1) -> jax.Array:
+    """Rotate values around the axis ring — the primitive under ring
+    attention and pipeline transfers.  Maps to ICI-neighbor
+    CollectivePermute, the cheapest possible TPU collective."""
+    n = axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def barrier_value(axis_name: AxisNames) -> jax.Array:
+    """A data-dependent barrier: psum of 1 — any rank arriving late delays
+    everyone (eager-path barrier lives in `frameworks.jax.ops.barrier`)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)
+
+
+def axis_size(axis_name: AxisNames) -> int:
+    if isinstance(axis_name, (tuple, list)):
+        size = 1
+        for a in axis_name:
+            size *= lax.axis_size(a)
+        return size
+    return lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: str) -> jax.Array:
+    return lax.axis_index(axis_name)
+
+
+def hierarchical_allreduce(x: jax.Array, local_axis: str,
+                           cross_axis: str) -> jax.Array:
+    """Explicit 2-level allreduce: reduce-scatter on the fast axis, allreduce
+    on the slow axis, allgather back on the fast axis — the
+    `NCCLHierarchicalAllreduce` schedule (`nccl_operations.cc:194-405`)
+    written in XLA collectives.  On TPU XLA usually derives this on its own
+    for a (dcn, ici) mesh; this exists for explicit control and for parity
+    with `HOROVOD_HIERARCHICAL_ALLREDUCE` (`operations.cc:486-495`).
+    """
+    shard = lax.psum_scatter(x.reshape(-1), local_axis, scatter_dimension=0,
+                             tiled=True)
+    shard = lax.psum(shard, cross_axis)
+    full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
+    return full.reshape(x.shape)

@@ -1,0 +1,44 @@
+"""SPMD gradient synchronization — DistributedOptimizer's compiled-path core.
+
+The reference's `DistributedOptimizer` (torch `optimizer.py:32`, TF
+`tensorflow/__init__.py:465`) allreduces every gradient tensor through the
+background runtime.  Inside jit the same contract is one line per leaf:
+``lax.pmean`` over the data axes.  Fusion, bucketing and overlap — the
+things `FuseResponses` (`controller.cc:859-998`) and WFBP hooks buy on GPU —
+are XLA's job here (its allreduce combiner merges small collectives and
+schedules them over ICI concurrently with the backward pass).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+from jax import lax
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def allreduce_gradients(grads: Any, axis_name: AxisNames = "data",
+                        op: str = "average",
+                        prescale_factor: Optional[float] = None,
+                        postscale_factor: Optional[float] = None) -> Any:
+    """Allreduce a gradient pytree across data-parallel replicas.
+
+    ``op='average'`` matches the reference default (`Average`,
+    postscale-by-1/size, `operations.cc:953-956`).
+    """
+    from .collectives import allreduce
+
+    def _sync(g):
+        return allreduce(g, axis_name, op=op,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
+
+    return jax.tree_util.tree_map(_sync, grads)
+
+
+def cross_replica_mean(tree: Any, axis_name: AxisNames = "data") -> Any:
+    """pmean over a pytree (metrics averaging — the role of Keras
+    `MetricAverageCallback`, reference `_keras/callbacks.py:48`)."""
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)

@@ -1,0 +1,75 @@
+"""Expert parallelism: gating + all_to_all dispatch/combine.
+
+The reference exposes only the raw alltoall primitive
+(`operations.cc:1081-1142`; SURVEY §2.9 notes it as the building block
+"users could use for MoE-style exchange, but no EP strategy ships").  Here
+the strategy ships: Switch-style top-1 routing with capacity, tokens
+exchanged over the ``expert`` mesh axis with two tiled ``all_to_all``s
+(dispatch and return), one expert per axis member.
+
+Capacity drops are the standard trade: tokens over an expert's capacity
+pass through unchanged (residual connection keeps them sane), keeping all
+shapes static for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import AXIS_EXPERT
+
+
+def moe_dispatch_combine(x: jax.Array, gate_logits: jax.Array,
+                         expert_fn: Callable[[jax.Array], jax.Array],
+                         axis_name: str = AXIS_EXPERT,
+                         capacity_factor: float = 1.25,
+                         capacity: Optional[int] = None) -> jax.Array:
+    """Top-1 MoE layer body; inside ``shard_map`` over ``axis_name``.
+
+    - ``x``: local tokens ``[t, d]``;
+    - ``gate_logits``: ``[t, n_experts]`` with ``n_experts == axis_size``;
+    - ``expert_fn``: this device's expert, ``[c, d] -> [c, d]``.
+
+    Returns ``[t, d]``: gate-weighted expert outputs (dropped tokens get 0,
+    callers add the residual).
+    """
+    n = lax.axis_size(axis_name)
+    t, d = x.shape
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * t / n))
+    c = capacity
+
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # [t, n]
+    expert_idx = jnp.argmax(probs, axis=-1)                           # [t]
+    gate = jnp.max(probs, axis=-1)                                    # [t]
+    onehot = jax.nn.one_hot(expert_idx, n, dtype=jnp.float32)         # [t, n]
+    # Position of each token within its expert's queue; >=c means dropped.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot                # [t, n]
+    keep = (pos < c) * onehot
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), c, dtype=jnp.float32)
+    dispatch = keep[..., None] * pos_oh                               # [t, n, c]
+
+    # [n, c, d]: slot (e, j) holds the j-th local token routed to expert e.
+    send = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # Exchange: device e receives every peer's slice for expert e.
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                                 # [n, c, d]
+    out = expert_fn(recv.reshape(n * c, d).astype(x.dtype))
+    out = out.reshape(n, c, d).astype(jnp.float32)
+    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                                 # [n, c, d]
+    combine = dispatch * gate[:, None, None]
+    return jnp.einsum("tec,ecd->td", combine, back).astype(x.dtype)
+
+
+def load_balancing_loss(gate_logits: jax.Array, axis_name: str = AXIS_EXPERT) -> jax.Array:
+    """Switch-Transformer auxiliary loss: n * sum(fraction_tokens * mean_prob)."""
+    n = gate_logits.shape[-1]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), n), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return n * jnp.sum(frac * mean_prob)

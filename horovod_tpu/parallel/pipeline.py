@@ -1,0 +1,78 @@
+"""Pipeline parallelism over a ``pipe`` mesh axis.
+
+Not in the reference (SURVEY §2.9: PP absent) — included because on TPU
+pipelining is mesh machinery, not a separate runtime: stages are shards of
+a stacked parameter tree over the ``pipe`` axis, activations move to the
+next stage with ``ppermute`` (neighbor CollectivePermute on ICI), and the
+schedule is a ``lax.scan`` — compiler-friendly, no host control flow.
+
+Schedule: GPipe-style fill-drain.  With M microbatches and N stages the
+scan runs M+N-1 ticks; stage s computes microbatch t-s at tick t.  Bubble
+fraction (N-1)/(M+N-1) — callers pick M >= 4N to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import AXIS_PIPE
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, microbatches: jax.Array,
+                   axis_name: str = AXIS_PIPE) -> jax.Array:
+    """Run microbatches through the pipeline; inside ``shard_map``.
+
+    - ``stage_fn(params, x) -> y``: one stage's computation; every stage
+      must map the same activation shape to itself (classic equal-width
+      pipeline).
+    - ``stage_params``: this stage's parameter pytree (callers shard a
+      stacked tree over ``pipe`` and squeeze the leading axis).
+    - ``microbatches``: ``[M, micro_batch, ...]`` — the real inputs on
+      stage 0 (other stages' values are ignored).
+
+    Returns ``[M, micro_batch, ...]`` outputs, identical on every stage
+    (the last stage's results are broadcast back so downstream loss code
+    is stage-agnostic).
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # Stage 0 injects microbatch t (clamped during drain); others take
+        # the activation handed to them last tick.
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        x = jnp.where(stage == 0, mb, incoming)
+        y = stage_fn(stage_params, x)
+        # Last stage banks microbatch t-(n-1) once the pipe is full.
+        out_t = t - (n - 1)
+        outputs = lax.cond(
+            out_t >= 0,
+            lambda o: lax.dynamic_update_index_in_dim(o, y, jnp.clip(out_t, 0, m - 1), 0),
+            lambda o: o, outputs)
+        nxt = lax.ppermute(y, axis_name, perm=perm)
+        return (nxt, outputs), None
+
+    incoming0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (incoming0, outputs0),
+                               jnp.arange(m + n - 1))
+    # outputs is only real on the last stage; broadcast it to all stages
+    # (masked psum — lowers to an efficient one-to-all on ICI).
+    masked = jnp.where(stage == n - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(masked, axis_name)
+
+
+def stack_stage_params(params_per_stage) -> Any:
+    """Stack a list of per-stage pytrees into one tree with a leading
+    ``pipe`` axis, ready to shard with ``P('pipe', ...)``."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *params_per_stage)

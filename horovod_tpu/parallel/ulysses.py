@@ -1,0 +1,78 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The reference ships the raw uneven alltoall primitive
+(`operations.cc:1081-1142`) that SURVEY §5.7 identifies as "the
+communication pattern Ulysses-style SP would need" — this module is that
+pattern realized on TPU.  Two all_to_alls per attention call:
+
+1. before attention: reshard from sequence-split/head-full to
+   sequence-full/head-split (each device then holds ``heads/n`` full-length
+   heads and runs ordinary attention on them);
+2. after attention: reshard back.
+
+Compared with ring attention: Ulysses moves activations twice via
+all-to-all (bandwidth ~2·B·S·H·D/n per device, latency-friendly on ICI's
+all-to-all-capable torus) but runs plain unmodified attention in between,
+so it composes with any attention kernel (flash, pallas) untouched.  Ring
+keeps K/V streaming with n ppermutes and never materializes the full
+sequence — better above ~128k tokens or when heads < devices.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import AXIS_SEQ
+
+
+def _default_attention(q, k, v, causal: bool, sm_scale: Optional[float]):
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[b, s/n, h, d] sequence-sharded → [b, s, h/n, d] head-sharded."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[b, s, h/n, d] head-sharded → [b, s/n, h, d] sequence-sharded."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = AXIS_SEQ, causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      attention_fn: Optional[Callable] = None) -> jax.Array:
+    """All-to-all sequence-parallel attention.
+
+    Inside ``shard_map``; local shards ``[batch, seq_shard, heads,
+    head_dim]`` with ``heads % axis_size == 0``.  ``attention_fn(q, k, v)``
+    may be any full-sequence attention (e.g. a pallas flash kernel); the
+    default is plain softmax attention.
+    """
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the seq axis "
+            f"size ({n}); use ring_attention otherwise")
+    qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
+    if attention_fn is None:
+        out = _default_attention(qh, kh, vh, causal, sm_scale)
+    else:
+        out = attention_fn(qh, kh, vh)
+    return heads_to_seq(out, axis_name)

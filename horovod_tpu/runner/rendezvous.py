@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 from urllib.parse import unquote
+
+from ..transport.store import MemoryStore
 
 RANK_AND_SIZE_SCOPE = "rank_and_size"
 
@@ -75,21 +77,20 @@ class _KVServer(ThreadingHTTPServer):
 
     def __init__(self, addr, delete_hook=None):
         super().__init__(addr, _Handler)
-        self._data: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        # Compose the canonical MemoryStore so storage semantics (keying,
+        # locking) live in exactly one place (transport/store.py).
+        self._store = MemoryStore()
         self._delete_hook = delete_hook
 
     def store_set(self, scope: str, key: str, value: bytes) -> None:
-        with self._lock:
-            self._data[f"{scope}/{key}"] = value
+        self._store.set(scope, key, value)
 
     def store_get(self, scope: str, key: str) -> Optional[bytes]:
-        with self._lock:
-            return self._data.get(f"{scope}/{key}")
+        return self._store.get(scope, key)
 
     def store_delete(self, scope: str, key: str) -> bool:
-        with self._lock:
-            existed = self._data.pop(f"{scope}/{key}", None) is not None
+        existed = self._store.get(scope, key) is not None
+        self._store.delete(scope, key)
         if existed and self._delete_hook is not None:
             self._delete_hook(scope, key)
         return existed

@@ -2,13 +2,23 @@
 
 Multi-chip TPU hardware is not available in CI; all sharding/collective tests
 run against `--xla_force_host_platform_device_count=8` CPU devices, mirroring
-how the driver dry-runs the multi-chip path.  Must run before jax is
-imported anywhere in the test process.
+how the driver dry-runs the multi-chip path.
+
+Two wrinkles: the outer environment may pin ``JAX_PLATFORMS`` to the real
+TPU platform, and installed pytest plugins import jax before this conftest
+runs (so jax has already latched the env value into its config).  Hence we
+hard-set the env *and* update the live jax config.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -18,6 +18,14 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PREAMBLE = """
 import os, sys
+# JAX_PLATFORMS=cpu in the env is NOT enough on this machine: the axon
+# sitecustomize overrides the platform via jax.config at import time, so
+# workers must override it back or they contend for the one real TPU chip.
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 import numpy as np
 import horovod_tpu as hvd
 hvd.init()
