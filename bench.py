@@ -1,0 +1,87 @@
+"""Headline benchmark: ResNet-50 synthetic training throughput (images/sec).
+
+Mirror of the reference's synthetic benchmark
+(`examples/tensorflow2/tensorflow2_synthetic_benchmark.py`: ResNet-50,
+synthetic ImageNet-shaped batches, warmup then timed iterations, reports
+images/sec).  Runs on whatever accelerator is attached (the driver gives one
+TPU chip); falls back to CPU with a tiny config so the script always
+produces its JSON line.
+
+``vs_baseline``: the only absolute throughput the reference publishes is
+`docs/benchmarks.rst:32-43` — 1656.82 images/sec on 16 Pascal GPUs
+(ResNet-101 bs=64) = 103.55 images/sec/GPU.  BASELINE.md's per-chip metric
+is measured against that per-device figure.
+
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+REFERENCE_PER_DEVICE_IMG_PER_SEC = 1656.82 / 16  # docs/benchmarks.rst:32-43
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.models.training import (
+        create_train_state,
+        make_sharded_train_step,
+    )
+    from horovod_tpu.parallel import MeshSpec, build_mesh, shard_batch
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch_size = 128 if on_tpu else 8
+    image_size = 224 if on_tpu else 64
+    warmup, iters = 5, 30 if on_tpu else 5
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    model = ResNet50(num_classes=1000,
+                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    tx = optax.sgd(0.01, momentum=0.9)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch_size, image_size, image_size, 3),
+                    jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, size=(batch_size,)), jnp.int32)
+    batch = shard_batch(mesh, {"x": x, "y": y})
+
+    state = create_train_state(model, jax.random.PRNGKey(0), x, tx,
+                               mesh=mesh, init_kwargs={"train": True})
+    step = make_sharded_train_step(model, tx, mesh, has_batch_stats=True,
+                                   donate=True)
+
+    # Sync points use device_get of the step's loss, not block_until_ready:
+    # the attached TPU backend can report buffers ready before remote
+    # execution finishes, but a host transfer of the final loss cannot
+    # complete early — it transitively waits on every chained step.
+    for _ in range(warmup):
+        state, loss = step(state, batch)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, batch)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    img_per_sec = batch_size * iters / dt
+    n_dev = len(jax.devices())
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(img_per_sec / n_dev, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / n_dev /
+                             REFERENCE_PER_DEVICE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Adasum: scale-insensitive gradient reduction via VHDD.
+
+Reference: ``adasum/adasum.h:194-450`` (templated vector-halving
+distance-doubling recursive allreduce whose combine step is the Adasum
+operator) and ``adasum_mpi_operations.cc`` (the MPI point-to-point
+realization).  The operator merges two gradients a, b as::
+
+    a' = (1 - dot(a,b) / (2*||a||^2)) * a + (1 - dot(a,b) / (2*||b||^2)) * b
+
+so identical directions average and orthogonal directions add — a
+reduction that adapts to gradient correlation instead of assuming
+independence (Microsoft's Adasum paper).  Dot products and norms accumulate
+in fp64 exactly like the reference's ``double`` accumulators
+(``adasum.h:101-140``).
+
+Schedule (VHDD, power-of-two ranks like the reference): at distance d =
+1, 2, 4, ..., each rank pairs with ``rank ^ d``, exchanges the half of the
+buffer the peer owns, combines its kept half with Adasum, recursing on a
+half-sized vector each round; then the halves are allgathered back by
+walking the distances in reverse.  Per-tensor dot/norm triplets are
+reduced per *tensor* (not per fused buffer) so fusion does not change the
+math — same property the reference maintains by carrying per-layer
+state.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..common.exceptions import HorovodInternalError
+from ..common.topology import ProcessTopology
+from ..core.messages import Response
+from ..core.tensor_queue import Status, TensorTableEntry
+from ..transport.tcp import TcpMesh
+from . import cpu_ring
+
+
+def _adasum_combine(a: np.ndarray, b: np.ndarray,
+                    bounds: List[Tuple[int, int]]) -> np.ndarray:
+    """Combine two equal-length fused segments tensor-by-tensor."""
+    out = np.empty_like(a)
+    for lo, hi in bounds:
+        av, bv = a[lo:hi], b[lo:hi]
+        dot = float(np.dot(av.astype(np.float64), bv.astype(np.float64)))
+        na2 = float(np.dot(av.astype(np.float64), av.astype(np.float64)))
+        nb2 = float(np.dot(bv.astype(np.float64), bv.astype(np.float64)))
+        ca = 1.0 - dot / (2.0 * na2) if na2 > 0 else 0.5
+        cb = 1.0 - dot / (2.0 * nb2) if nb2 > 0 else 0.5
+        out[lo:hi] = ca * av + cb * bv
+    return out
+
+
+def _segment_bounds(sizes: List[int], lo: int, hi: int) -> List[Tuple[int, int]]:
+    """Tensor boundaries clipped to the [lo, hi) slice of the fused buffer,
+    re-based to slice-local offsets."""
+    bounds = []
+    off = 0
+    for n in sizes:
+        t_lo, t_hi = max(off, lo), min(off + n, hi)
+        if t_lo < t_hi:
+            bounds.append((t_lo - lo, t_hi - lo))
+        off += n
+    return bounds or [(0, hi - lo)]
+
+
+class AdasumAllreduce(cpu_ring.CollectiveOp):
+    """VHDD Adasum over the TCP mesh, registered for ``ResponseType.ADASUM``."""
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        # VHDD needs a power-of-two world (reference adasum.h restriction);
+        # other sizes fall through to the ring-allreduce op registered
+        # behind this one in the ADASUM chain.
+        return (self.topo.size & (self.topo.size - 1)) == 0
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        size, rank = self.topo.size, self.topo.rank
+        if size == 1:
+            for e in entries:
+                e.output = np.array(e.tensor, copy=True)
+            return Status.OK()
+        if size & (size - 1):
+            raise HorovodInternalError(
+                f"Adasum VHDD requires a power-of-two world size, got {size} "
+                f"(reference adasum.h has the same restriction)")
+
+        acc_dtype = cpu_ring._accum_dtype(entries[0].tensor.dtype)
+        buf = cpu_ring.fuse_entries(entries, acc_dtype)
+        sizes = [int(np.prod(e.tensor.shape)) if e.tensor.shape else 1
+                 for e in entries]
+        real_n = buf.size
+        # Zero-pad to a multiple of the world size so every halving round
+        # splits evenly; pad regions sit outside all tensor bounds, stay
+        # zero through combines, and are dropped before unfuse.
+        if real_n % size:
+            pad = size - real_n % size
+            buf = np.concatenate([buf, np.zeros(pad, acc_dtype)])
+        n = buf.size
+
+        # Vector-halving distance-doubling reduce-scatter with Adasum
+        # combine (reference adasum.h:194-320).
+        lo, hi = 0, n
+        halves: List[Tuple[int, bool]] = []  # (distance, kept_upper)
+        distance = 1
+        while distance < size:
+            peer = rank ^ distance
+            mid = lo + (hi - lo) // 2
+            keep_upper = (rank & distance) != 0
+            if keep_upper:
+                send_lo, send_hi, keep_lo, keep_hi = lo, mid, mid, hi
+            else:
+                send_lo, send_hi, keep_lo, keep_hi = mid, hi, lo, mid
+            peer_half = np.frombuffer(
+                self.mesh.sendrecv(peer, buf[send_lo:send_hi].tobytes(), peer),
+                dtype=acc_dtype).copy()
+            kept = buf[keep_lo:keep_hi]
+            if peer_half.size != kept.size:
+                raise HorovodInternalError(
+                    "Adasum exchange size mismatch "
+                    f"({peer_half.size} vs {kept.size})")
+            bounds = _segment_bounds(sizes, keep_lo, keep_hi)
+            if rank < peer:
+                combined = _adasum_combine(kept, peer_half, bounds)
+            else:
+                combined = _adasum_combine(peer_half, kept, bounds)
+            buf[keep_lo:keep_hi] = combined
+            halves.append((distance, keep_upper))
+            lo, hi = keep_lo, keep_hi
+            distance <<= 1
+
+        # Allgather back: walk distances in reverse, exchanging the owned
+        # slice for the peer's (reference adasum.h:321-380).
+        for distance, keep_upper in reversed(halves):
+            peer = rank ^ distance
+            span = hi - lo
+            if keep_upper:
+                other_lo, other_hi = lo - span, lo
+            else:
+                other_lo, other_hi = hi, hi + span
+            peer_data = np.frombuffer(
+                self.mesh.sendrecv(peer, buf[lo:hi].tobytes(), peer),
+                dtype=acc_dtype)
+            buf[other_lo:other_hi] = peer_data
+            lo, hi = min(lo, other_lo), max(hi, other_hi)
+
+        buf = buf[:real_n]
+        if response.postscale_factor != 1.0:
+            buf = buf * response.postscale_factor
+        cpu_ring.unfuse_entries(
+            buf.astype(response.tensor_type.to_numpy(), copy=False), entries)
+        return Status.OK()

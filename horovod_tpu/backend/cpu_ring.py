@@ -59,10 +59,14 @@ def _accum_dtype(dtype: np.dtype) -> np.dtype:
 
 
 def fuse_entries(entries: List[TensorTableEntry], dtype: np.dtype) -> np.ndarray:
-    """MemcpyInFusionBuffer analog (``collective_operations.cc``)."""
+    """MemcpyInFusionBuffer analog (``collective_operations.cc``).
+
+    Always returns a fresh buffer in ``dtype`` — never a view of an entry's
+    tensor, so backends may mutate it freely without corrupting user input."""
     if len(entries) == 1:
-        return np.ascontiguousarray(entries[0].tensor).ravel()
-    return np.concatenate([np.asarray(e.tensor).ravel() for e in entries])
+        return np.asarray(entries[0].tensor).ravel().astype(dtype, copy=True)
+    return np.concatenate(
+        [np.asarray(e.tensor).ravel() for e in entries]).astype(dtype, copy=False)
 
 
 def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry]) -> None:
@@ -76,14 +80,16 @@ def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry]) -> None:
 
 class RingAllreduce(CollectiveOp):
     def enabled(self, response, entries) -> bool:
-        return response.response_type in (ResponseType.ALLREDUCE,)
+        # Also serves as the ADASUM fallback for non-power-of-two worlds
+        # (plain sum; the reference simply refuses such sizes).
+        return response.response_type in (ResponseType.ALLREDUCE,
+                                          ResponseType.ADASUM)
 
     def execute(self, response: Response,
                 entries: List[TensorTableEntry]) -> Status:
         np_dtype = response.tensor_type.to_numpy()
-        buf = fuse_entries(entries, np_dtype)
-        acc = _accum_dtype(buf.dtype)
-        work = buf.astype(acc, copy=True)
+        acc = _accum_dtype(np_dtype)
+        work = fuse_entries(entries, acc)
 
         if response.prescale_factor != 1.0:
             work *= response.prescale_factor

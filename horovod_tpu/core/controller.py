@@ -61,7 +61,11 @@ class _TableEntry:
 class Controller:
     def __init__(self, topology: ProcessTopology, mesh: Optional[TcpMesh],
                  fusion_threshold_bytes: int = 64 * 1024 * 1024,
-                 stall_warning_secs: float = 60.0):
+                 stall_warning_secs: float = 60.0,
+                 cache_capacity: int = 1024,
+                 parameter_manager=None):
+        from .response_cache import CoordinatorCache, WorkerCacheMirror
+
         self.topo = topology
         self.mesh = mesh
         self.fusion_threshold = fusion_threshold_bytes
@@ -70,6 +74,18 @@ class Controller:
         self._joined_ranks: Set[int] = set()
         self._last_stall_check = time.monotonic()
         self.timeline = None  # coordinator-side negotiation lanes
+        self.param_manager = parameter_manager
+        # Cache fast path (response_cache.py): coordinator owns assignments,
+        # workers mirror keys; disabled when capacity <= 0.
+        self.cache_enabled = cache_capacity > 0 and topology.size > 1
+        self._cache = CoordinatorCache(cache_capacity) \
+            if self.cache_enabled and topology.rank == 0 else None
+        self._mirror = WorkerCacheMirror() \
+            if self.cache_enabled and topology.rank != 0 else None
+        self._cycle_assignments: List[tuple] = []
+        self._cycle_evictions: List[int] = []
+        self.cache_hit_count = 0
+        self.cache_miss_count = 0
         # FIFO completion order like the reference: responses are emitted in
         # the order tensors *complete*, which is deterministic because only
         # the coordinator decides it.
@@ -90,12 +106,32 @@ class Controller:
 
     def _worker_round(self, requests: List[Request],
                       should_shutdown: bool) -> ResponseList:
-        payload = RequestList(requests=requests, shutdown=should_shutdown).to_bytes()
+        hits: List[int] = []
+        if self._mirror is not None:
+            misses = []
+            for req in requests:
+                bit = self._mirror.hit(req)
+                if bit is not None:
+                    hits.append(bit)
+                else:
+                    misses.append(req)
+            requests = misses
+            self.cache_hit_count += len(hits)
+            self.cache_miss_count += len(requests)
+        payload = RequestList(requests=requests, shutdown=should_shutdown,
+                              cache_hits=hits).to_bytes()
         self.mesh.send(0, payload)
-        return ResponseList.from_bytes(self.mesh.recv(0))
+        rlist = ResponseList.from_bytes(self.mesh.recv(0))
+        if self._mirror is not None:
+            self._mirror.apply(rlist.cache_assignments, rlist.evicted_bits)
+        if rlist.tuned_params is not None:
+            self.fusion_threshold = rlist.tuned_params[0]
+        return rlist
 
     def _coordinator_round(self, own_requests: List[Request],
                            should_shutdown: bool) -> ResponseList:
+        self._cycle_assignments = []
+        self._cycle_evictions = []
         ready: List[str] = []
         for req in own_requests:
             if self._increment(req):
@@ -103,6 +139,14 @@ class Controller:
         for worker in range(1, self.topo.size):
             rl = RequestList.from_bytes(self.mesh.recv(worker))
             should_shutdown = should_shutdown or rl.shutdown
+            for bit in rl.cache_hits:
+                req = self._cache.rehydrate(bit, worker) \
+                    if self._cache is not None else None
+                if req is None:
+                    log.error("rank %d hit unknown cache bit %d", worker, bit)
+                    continue
+                if self._increment(req):
+                    ready.append(req.tensor_name)
             for req in rl.requests:
                 if self._increment(req):
                     ready.append(req.tensor_name)
@@ -122,14 +166,34 @@ class Controller:
 
         responses = [self._construct_response(name) for name in ready]
         responses = [r for r in responses if r is not None]
+        tuned = self._autotune(responses)
         responses = self._fuse_responses(responses)
         self._check_stalls()
+        if self._cache is not None:
+            self._cache.tick()
 
-        rlist = ResponseList(responses=responses, shutdown=should_shutdown)
+        rlist = ResponseList(responses=responses, shutdown=should_shutdown,
+                             cache_assignments=self._cycle_assignments,
+                             evicted_bits=self._cycle_evictions,
+                             tuned_params=tuned)
         payload = rlist.to_bytes()
         for worker in range(1, self.topo.size):
             self.mesh.send(worker, payload)
         return rlist
+
+    def _autotune(self, responses: List[Response]):
+        """Feed the cycle's reduced byte volume to the ParameterManager;
+        returns new (fusion_bytes, cycle_ms) when the tuner moves."""
+        if self.param_manager is None or not self.param_manager.enabled:
+            return None
+        nbytes = sum(
+            sum(r.tensor_sizes) * r.tensor_type.itemsize
+            for r in responses
+            if r.response_type in (ResponseType.ALLREDUCE, ResponseType.ADASUM))
+        tuned = self.param_manager.update(nbytes)
+        if tuned is not None:
+            self.fusion_threshold = tuned[0]
+        return tuned
 
     def _single_process_responses(self, requests: List[Request],
                                   should_shutdown: bool) -> ResponseList:
@@ -296,6 +360,11 @@ class Controller:
             RequestType.ALLTOALL: ResponseType.ALLTOALL,
             RequestType.BARRIER: ResponseType.BARRIER,
         }[op]
+        if self._cache is not None:
+            bit, evicted = self._cache.maybe_insert(first)
+            self._cycle_evictions.extend(evicted)
+            if bit is not None:
+                self._cycle_assignments.append((bit, first))
         return Response(
             response_type=rtype,
             tensor_names=[name],

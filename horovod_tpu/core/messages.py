@@ -258,11 +258,16 @@ class Request:
 class RequestList:
     requests: List[Request] = field(default_factory=list)
     shutdown: bool = False
+    # Cache-hit bit positions (response_cache.py): tensors re-announced at
+    # 4 bytes instead of a full Request — the steady-state fast path
+    # (reference bitvector sync, ``controller.cc:826-851``).
+    cache_hits: List[int] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         w = Writer()
         w.u32(WIRE_MAGIC)
         w.u8(1 if self.shutdown else 0)
+        w.i32_list(self.cache_hits)
         w.u32(len(self.requests))
         for req in self.requests:
             req.serialize(w)
@@ -274,8 +279,10 @@ class RequestList:
         if r.u32() != WIRE_MAGIC:
             raise ValueError("bad request-list magic")
         shutdown = bool(r.u8())
+        cache_hits = r.i32_list()
         reqs = [Request.deserialize(r) for _ in range(r.u32())]
-        return RequestList(requests=reqs, shutdown=shutdown)
+        return RequestList(requests=reqs, shutdown=shutdown,
+                           cache_hits=cache_hits)
 
 
 @dataclass
@@ -326,11 +333,30 @@ class Response:
 class ResponseList:
     responses: List[Response] = field(default_factory=list)
     shutdown: bool = False
+    # Coordinator-authoritative cache maintenance (response_cache.py):
+    # (bit, request-template) assignments workers mirror, and evictions.
+    cache_assignments: List[tuple] = field(default_factory=list)
+    evicted_bits: List[int] = field(default_factory=list)
+    # Autotuned runtime parameters, broadcast when they change (reference
+    # ``SynchronizeParameters``, ``controller.cc:43-57``): (fusion_threshold
+    # bytes, cycle_time_ms) or None.
+    tuned_params: "tuple | None" = None
 
     def to_bytes(self) -> bytes:
         w = Writer()
         w.u32(WIRE_MAGIC)
         w.u8(1 if self.shutdown else 0)
+        w.i32_list(self.evicted_bits)
+        w.u32(len(self.cache_assignments))
+        for bit, template in self.cache_assignments:
+            w.i32(bit)
+            template.serialize(w)
+        if self.tuned_params is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            w.i64(int(self.tuned_params[0]))
+            w.f64(float(self.tuned_params[1]))
         w.u32(len(self.responses))
         for resp in self.responses:
             resp.serialize(w)
@@ -342,5 +368,15 @@ class ResponseList:
         if r.u32() != WIRE_MAGIC:
             raise ValueError("bad response-list magic")
         shutdown = bool(r.u8())
+        evicted = r.i32_list()
+        assignments = []
+        for _ in range(r.u32()):
+            bit = r.i32()
+            assignments.append((bit, Request.deserialize(r)))
+        tuned = None
+        if r.u8():
+            tuned = (r.i64(), r.f64())
         resps = [Response.deserialize(r) for _ in range(r.u32())]
-        return ResponseList(responses=resps, shutdown=shutdown)
+        return ResponseList(responses=resps, shutdown=shutdown,
+                            cache_assignments=assignments,
+                            evicted_bits=evicted, tuned_params=tuned)

@@ -1,0 +1,182 @@
+"""Online autotuning of fusion threshold + cycle time.
+
+Reference: ``parameter_manager.h:88-97`` / ``parameter_manager.cc`` with
+``optim/bayesian_optimization.cc`` + ``optim/gaussian_process.cc`` (Eigen):
+Bayesian optimization over (tensor_fusion_threshold_mb, cycle_time_ms),
+scoring observed negotiation throughput (bytes/sec), warmup-sample discard,
+winning parameters broadcast from the coordinator
+(``SynchronizeParameters``, ``controller.cc:43-57``).
+
+numpy plays Eigen's role; expected improvement is maximized over a random
+candidate set instead of LBFGS (the reference's GP hyperparameters are
+fixed; ours too).  Coordinator-only, like the reference (scores are
+computed from the coordinator's cycle observations; tuned values ride the
+ResponseList).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# Search space, matching the reference's grids
+# (`parameter_manager.cc` BayesianOptimization setup).
+_FUSION_MB_RANGE = (0.0, 64.0)
+_CYCLE_MS_RANGE = (1.0, 25.0)
+
+
+class GaussianProcess:
+    """RBF-kernel GP regression (reference ``optim/gaussian_process.cc``)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-8):
+        self.length_scale = length_scale
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._l_inv: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self._x = x
+        k = self._kernel(x, x) + self.noise * np.eye(len(x))
+        l = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(l.T, np.linalg.solve(l, y))
+        self._l_inv = np.linalg.inv(l)
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._alpha
+        v = self._l_inv @ ks.T
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return mu, np.sqrt(var)
+
+
+class BayesianOptimization:
+    """Expected-improvement acquisition over the 2-D knob space
+    (reference ``optim/bayesian_optimization.cc``)."""
+
+    def __init__(self, seed: int = 0, candidates: int = 256):
+        self._rng = np.random.RandomState(seed)
+        self._candidates = candidates
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+
+    @staticmethod
+    def _norm(p: Tuple[float, float]) -> np.ndarray:
+        f = (p[0] - _FUSION_MB_RANGE[0]) / (_FUSION_MB_RANGE[1] - _FUSION_MB_RANGE[0])
+        c = (p[1] - _CYCLE_MS_RANGE[0]) / (_CYCLE_MS_RANGE[1] - _CYCLE_MS_RANGE[0])
+        return np.array([f, c])
+
+    @staticmethod
+    def _denorm(x: np.ndarray) -> Tuple[float, float]:
+        return (
+            float(x[0]) * (_FUSION_MB_RANGE[1] - _FUSION_MB_RANGE[0]) + _FUSION_MB_RANGE[0],
+            float(x[1]) * (_CYCLE_MS_RANGE[1] - _CYCLE_MS_RANGE[0]) + _CYCLE_MS_RANGE[0],
+        )
+
+    def observe(self, params: Tuple[float, float], score: float) -> None:
+        self._xs.append(self._norm(params))
+        self._ys.append(score)
+
+    def suggest(self) -> Tuple[float, float]:
+        if len(self._xs) < 3:
+            return self._denorm(self._rng.rand(2))
+        x = np.stack(self._xs)
+        y = np.asarray(self._ys)
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        gp = GaussianProcess(length_scale=0.3, noise=1e-6)
+        gp.fit(x, (y - y_mean) / y_std)
+        cand = self._rng.rand(self._candidates, 2)
+        mu, sigma = gp.predict(cand)
+        best = (y.max() - y_mean) / y_std
+        z = (mu - best) / sigma
+        ei = sigma * (z * _phi_cdf(z) + _phi_pdf(z))
+        return self._denorm(cand[int(np.argmax(ei))])
+
+
+def _phi_pdf(z: np.ndarray) -> np.ndarray:
+    return np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+
+
+def _phi_cdf(z: np.ndarray) -> np.ndarray:
+    return 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+
+
+class ParameterManager:
+    """Coordinator-side tuning loop (reference ``ParameterManager::Update``,
+    ``parameter_manager.h:88``)."""
+
+    def __init__(self, enabled: bool = False, warmup_samples: int = 3,
+                 steps_per_sample: int = 10, max_samples: int = 20,
+                 initial_fusion_bytes: int = 64 * 1024 * 1024,
+                 initial_cycle_ms: float = 1.0,
+                 log_path: Optional[str] = None, seed: int = 0):
+        self.enabled = enabled
+        self.warmup_samples = warmup_samples
+        self.steps_per_sample = steps_per_sample
+        self.max_samples = max_samples
+        self._fusion_bytes = initial_fusion_bytes
+        self._cycle_ms = initial_cycle_ms
+        self._bo = BayesianOptimization(seed=seed)
+        self._samples_seen = 0
+        self._step_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = time.monotonic()
+        self._best: Tuple[float, Tuple[int, float]] = (
+            -1.0, (initial_fusion_bytes, initial_cycle_ms))
+        self._done = False
+        self._log = open(log_path, "w") if log_path else None
+
+    @property
+    def fusion_threshold_bytes(self) -> int:
+        return self._fusion_bytes
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return self._cycle_ms
+
+    def update(self, nbytes: int) -> Optional[Tuple[int, float]]:
+        """Record one negotiation cycle's reduced byte volume; returns new
+        (fusion_bytes, cycle_ms) when the tuner moves, else None."""
+        if not self.enabled or self._done:
+            return None
+        self._bytes_in_sample += nbytes
+        self._step_in_sample += 1
+        if self._step_in_sample < self.steps_per_sample:
+            return None
+
+        elapsed = max(time.monotonic() - self._sample_start, 1e-6)
+        score = self._bytes_in_sample / elapsed
+        params = (self._fusion_bytes / (1024.0 * 1024.0), self._cycle_ms)
+        self._samples_seen += 1
+        if self._log:
+            self._log.write(f"{self._samples_seen},{params[0]:.2f},"
+                            f"{params[1]:.2f},{score:.0f}\n")
+            self._log.flush()
+        if self._samples_seen > self.warmup_samples:
+            self._bo.observe(params, score)
+            if score > self._best[0]:
+                self._best = (score, (self._fusion_bytes, self._cycle_ms))
+
+        if self._samples_seen >= self.max_samples + self.warmup_samples:
+            # Settle on the best observed configuration.
+            self._fusion_bytes, self._cycle_ms = self._best[1]
+            self._done = True
+            if self._log:
+                self._log.close()
+                self._log = None
+        else:
+            fusion_mb, cycle = self._bo.suggest()
+            self._fusion_bytes = int(fusion_mb * 1024 * 1024)
+            self._cycle_ms = cycle
+
+        self._step_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = time.monotonic()
+        return (self._fusion_bytes, self._cycle_ms)

@@ -1,0 +1,151 @@
+"""Response cache — the steady-state negotiation fast path.
+
+Reference: ``response_cache.h:104-167`` / ``response_cache.cc`` +
+``CoordinateCacheAndState`` (``controller.cc:826-851``): after a tensor has
+been negotiated once, later cycles replace its full Request message with a
+single bit in a bitvector, synced by two bitwise allreduces; training
+steady-state (same tensors every step) negotiates at bitvector cost.
+
+Our control plane is a star (coordinator-authoritative), which permits a
+simpler, race-free design with the same wire win:
+
+- the **coordinator** owns the cache: it assigns a bit to each eligible
+  single-tensor Response it constructs, broadcasting (bit, request
+  template) assignments and evictions inside the ResponseList;
+- **workers** mirror only {key → bit}; when a pending Request matches a
+  mirrored key they send the bit instead of the Request;
+- the coordinator rehydrates a bit hit into the stored template (with the
+  hitting rank patched in), so tallying and validation are unchanged;
+- eviction is LRU at the coordinator (HOROVOD_CACHE_CAPACITY, reference
+  default 1024); evicted bits are tombstoned for a few cycles so hits
+  already in flight still resolve.
+
+Eligible ops: ALLREDUCE / ADASUM / BROADCAST — fixed per-rank metadata.
+ALLGATHER/ALLTOALL have per-rank shapes/splits that must travel every
+cycle, so caching them would not shrink the wire.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from .messages import Request, RequestType
+
+CACHEABLE = (RequestType.ALLREDUCE, RequestType.ADASUM, RequestType.BROADCAST)
+_TOMBSTONE_CYCLES = 4
+
+
+def cache_key(req: Request) -> Tuple:
+    return (req.tensor_name, int(req.request_type), int(req.tensor_type),
+            tuple(req.tensor_shape), req.root_rank, req.device,
+            req.prescale_factor, req.postscale_factor)
+
+
+class CoordinatorCache:
+    """Rank-0 side: bit assignment, LRU, tombstones."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = max(1, capacity)
+        self._by_bit: "OrderedDict[int, Tuple[Tuple, Request]]" = OrderedDict()
+        self._by_key: Dict[Tuple, int] = {}
+        self._by_name: Dict[str, int] = {}
+        self._tombstones: Dict[int, Tuple[Request, int]] = {}
+        self._next_bit = 0
+
+    def lookup(self, key: Tuple) -> Optional[int]:
+        bit = self._by_key.get(key)
+        if bit is not None:
+            self._by_bit.move_to_end(bit)
+        return bit
+
+    def rehydrate(self, bit: int, rank: int) -> Optional[Request]:
+        """Request template for a hit bit (tombstoned bits still resolve)."""
+        entry = self._by_bit.get(bit)
+        if entry is not None:
+            self._by_bit.move_to_end(bit)
+            return replace(entry[1], request_rank=rank)
+        tomb = self._tombstones.get(bit)
+        if tomb is not None:
+            return replace(tomb[0], request_rank=rank)
+        return None
+
+    def maybe_insert(self, req: Request) -> Tuple[Optional[int], List[int]]:
+        """Cache an eligible request; returns (new_bit|None, evicted_bits).
+
+        A same-name entry with a different key (tensor changed shape/dtype)
+        is evicted first, like the reference invalidating stale entries."""
+        if req.request_type not in CACHEABLE:
+            return None, []
+        evicted: List[int] = []
+        key = cache_key(req)
+        stale = self._by_name.get(req.tensor_name)
+        if stale is not None and self._by_bit.get(stale, (key,))[0] != key:
+            self._evict(stale)
+            evicted.append(stale)
+        if key in self._by_key:
+            return None, evicted
+        while len(self._by_bit) >= self.capacity:
+            old_bit = next(iter(self._by_bit))
+            self._evict(old_bit)
+            evicted.append(old_bit)
+        bit = self._next_bit
+        self._next_bit += 1
+        template = replace(req, request_rank=0)
+        self._by_bit[bit] = (key, template)
+        self._by_key[key] = bit
+        self._by_name[req.tensor_name] = bit
+        return bit, evicted
+
+    def _evict(self, bit: int) -> None:
+        entry = self._by_bit.pop(bit, None)
+        if entry is None:
+            return
+        key, template = entry
+        self._by_key.pop(key, None)
+        if self._by_name.get(template.tensor_name) == bit:
+            self._by_name.pop(template.tensor_name, None)
+        self._tombstones[bit] = (template, _TOMBSTONE_CYCLES)
+
+    def tick(self) -> None:
+        """Age tombstones one cycle."""
+        dead = []
+        for bit, (tpl, left) in self._tombstones.items():
+            if left <= 1:
+                dead.append(bit)
+            else:
+                self._tombstones[bit] = (tpl, left - 1)
+        for bit in dead:
+            self._tombstones.pop(bit, None)
+
+    def __len__(self) -> int:
+        return len(self._by_bit)
+
+
+class WorkerCacheMirror:
+    """Worker side: {key → bit} learned from ResponseList assignments."""
+
+    def __init__(self):
+        self._by_key: Dict[Tuple, int] = {}
+        self._by_bit: Dict[int, Tuple] = {}
+
+    def hit(self, req: Request) -> Optional[int]:
+        return self._by_key.get(cache_key(req))
+
+    def apply(self, assignments: List[Tuple[int, Request]],
+              evicted_bits: List[int]) -> None:
+        # Assignments first: bit ids are never reused, so an eviction in the
+        # same batch is always the *later* event for its bit (a capacity
+        # eviction can hit a bit assigned earlier in the same cycle).
+        for bit, template in assignments:
+            key = cache_key(template)
+            self._by_key[key] = bit
+            self._by_bit[bit] = key
+        for bit in evicted_bits:
+            key = self._by_bit.pop(bit, None)
+            if key is not None:
+                self._by_key.pop(key, None)
+
+    def __len__(self) -> int:
+        return len(self._by_key)

@@ -110,9 +110,25 @@ class HorovodGlobalState:
         stall_secs = 0 if env_mod.get_bool(env_mod.HOROVOD_STALL_CHECK_DISABLE) \
             else env_mod.get_float(env_mod.HOROVOD_STALL_CHECK_TIME_SECONDS,
                                    env_mod.DEFAULT_STALL_CHECK_TIME_SECONDS)
-        self.controller = Controller(topo, self.mesh,
-                                     fusion_threshold_bytes=fusion,
-                                     stall_warning_secs=stall_secs)
+        if env_mod.get_bool(env_mod.HOROVOD_AUTOTUNE) and topo.rank == 0:
+            from .parameter_manager import ParameterManager
+
+            self.parameter_manager = ParameterManager(
+                enabled=True,
+                warmup_samples=env_mod.get_int(
+                    env_mod.HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+                steps_per_sample=env_mod.get_int(
+                    env_mod.HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),
+                initial_fusion_bytes=fusion,
+                initial_cycle_ms=self.cycle_time_ms,
+                log_path=env_mod.get_str(env_mod.HOROVOD_AUTOTUNE_LOG) or None)
+        self.controller = Controller(
+            topo, self.mesh,
+            fusion_threshold_bytes=fusion,
+            stall_warning_secs=stall_secs,
+            cache_capacity=env_mod.get_int(env_mod.HOROVOD_CACHE_CAPACITY,
+                                           env_mod.DEFAULT_CACHE_CAPACITY),
+            parameter_manager=self.parameter_manager)
         timeline_path = env_mod.get_str(env_mod.HOROVOD_TIMELINE)
         if timeline_path:
             # Reference writes the timeline only on the coordinator
@@ -138,7 +154,12 @@ class HorovodGlobalState:
             ResponseType.BROADCAST, cpu_ring.StarBroadcast(topo, mesh))
         self.op_manager.register(
             ResponseType.ALLTOALL, cpu_ring.PairwiseAlltoall(topo, mesh))
-        # ADASUM falls back to ring allreduce until the VHDD op registers.
+        from ..backend.adasum import AdasumAllreduce
+
+        self.op_manager.register(
+            ResponseType.ADASUM, AdasumAllreduce(topo, mesh))
+        # Non-power-of-two worlds fall back to ring allreduce (the reference
+        # simply rejects them; a fallback keeps hvd.Adasum usable anywhere).
         self.op_manager.register(
             ResponseType.ADASUM, cpu_ring.RingAllreduce(topo, mesh))
 
@@ -155,12 +176,13 @@ class HorovodGlobalState:
             return
         self.initialized.set()
 
-        cycle = self.cycle_time_ms / 1000.0
         try:
             while True:
                 start = time.monotonic()
                 if not self._run_loop_once():
                     break
+                # Re-read each cycle: the autotuner may retune it mid-run.
+                cycle = self.cycle_time_ms / 1000.0
                 elapsed = time.monotonic() - start
                 if elapsed < cycle:
                     time.sleep(cycle - elapsed)
@@ -186,6 +208,10 @@ class HorovodGlobalState:
         response_list = self.controller.compute_response_list(
             requests, self.shutdown_requested.is_set())
         self.cycle_count += 1
+        if response_list.tuned_params is not None:
+            # Autotuner moved (reference SynchronizeParameters): adopt the
+            # broadcast cycle time on every rank.
+            self.cycle_time_ms = response_list.tuned_params[1]
         if self.timeline is not None:
             self.timeline.mark_cycle()
         for response in response_list.responses:

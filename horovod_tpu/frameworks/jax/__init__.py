@@ -37,3 +37,15 @@ from .ops import (  # noqa: F401
     poll,
     synchronize,
 )
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from .optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    distributed_value_and_grad,
+)
+from .sync_batch_norm import SyncBatchNorm, SyncBatchNormalization  # noqa: F401

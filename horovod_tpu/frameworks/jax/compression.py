@@ -1,0 +1,82 @@
+"""Gradient compression for the eager wire path.
+
+Reference: ``torch/compression.py:1-74`` / ``tensorflow/compression.py`` —
+``Compression.none`` and ``Compression.fp16`` compress a tensor before
+enqueue and decompress the collective's output.  On TPU the native 16-bit
+format is bfloat16 (same exponent range as fp32 — no scale tricks needed),
+so that is the default half-precision compressor; fp16 is kept for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor) -> Tuple[Any, Any]:
+        """Returns (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+def _cast(tensor, dtype):
+    try:
+        import jax.numpy as jnp
+
+        if not isinstance(tensor, np.ndarray):
+            return jnp.asarray(tensor, dtype)
+    except ImportError:  # pragma: no cover
+        pass
+    return np.asarray(tensor).astype(dtype)
+
+
+class _HalfCompressor(Compressor):
+    wire_dtype: Any = None
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = getattr(tensor, "dtype", None)
+        if dtype is not None and np.dtype(dtype) in (np.float32, np.float64):
+            return _cast(tensor, cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else _cast(tensor, ctx)
+
+
+class FP16Compressor(_HalfCompressor):
+    wire_dtype = np.float16
+
+
+class BF16Compressor(_HalfCompressor):
+    try:
+        import ml_dtypes as _mld
+
+        wire_dtype = _mld.bfloat16
+    except ImportError:  # pragma: no cover
+        wire_dtype = np.float16
+
+
+class Compression:
+    """Namespace mirroring ``hvd.Compression`` (reference API)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

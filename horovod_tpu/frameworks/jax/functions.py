@@ -1,0 +1,81 @@
+"""Parameter/object broadcast + allgather helpers.
+
+Role of the reference's ``torch/functions.py:30-257`` and
+``tensorflow/functions.py``: fan a restored checkpoint (or rank-0 init) out
+to all ranks, and move arbitrary picklable objects over the collective
+fabric by encoding them as uint8 tensors.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Optional
+
+import numpy as np
+
+from . import ops
+from .basics import rank, size
+
+try:
+    import cloudpickle as _pickler
+except ImportError:  # pragma: no cover
+    _pickler = pickle
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Broadcast a pytree of arrays from ``root_rank`` to all ranks
+    (reference ``broadcast_parameters``, ``torch/functions.py:30``).
+
+    Returns the synced pytree (jax arrays are immutable — no in-place)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = [
+        ops.broadcast(leaf, root_rank, name=f"broadcast.param.{i}")
+        for i, leaf in enumerate(leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Optax state is a pytree of arrays/scalars — same mechanics as
+    parameters (the reference needs a dedicated reconstruction dance for
+    torch's dict-shaped state, ``torch/functions.py:62``; pytrees don't)."""
+    return broadcast_parameters(opt_state, root_rank=root_rank)
+
+
+def broadcast_object(obj: Any = None, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Pickle → uint8 tensor → size bcast + payload bcast → unpickle
+    (reference ``broadcast_object``, ``torch/functions.py:186``)."""
+    name = name or "broadcast.object"
+    if rank() == root_rank:
+        payload = _pickler.dumps(obj)
+        buf = np.frombuffer(payload, dtype=np.uint8)
+    else:
+        buf = np.empty(0, np.uint8)
+    sz = ops.broadcast(np.array([buf.size], np.int64), root_rank,
+                       name=f"{name}.size")
+    n = int(np.asarray(sz)[0])
+    if rank() != root_rank:
+        buf = np.zeros(n, np.uint8)
+    data = np.asarray(ops.broadcast(buf, root_rank, name=f"{name}.data"))
+    return _pickler.loads(data.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """Gather one picklable object per rank; returns a list indexed by rank
+    (reference ``allgather_object``, ``torch/functions.py:219``)."""
+    name = name or "allgather.object"
+    payload = np.frombuffer(_pickler.dumps(obj), dtype=np.uint8)
+    sizes = np.asarray(ops.allgather(
+        np.array([payload.size], np.int64), name=f"{name}.size"))
+    data = np.asarray(ops.allgather(payload, name=f"{name}.data"))
+    out: List[Any] = []
+    offset = 0
+    for i in range(size()):
+        n = int(sizes[i])
+        out.append(_pickler.loads(data[offset:offset + n].tobytes()))
+        offset += n
+    return out

@@ -1,0 +1,44 @@
+"""Synchronous BatchNorm.
+
+Reference: ``torch/sync_batch_norm.py:1-199`` / ``tensorflow/
+sync_batch_norm.py:32-55`` — hand-written cross-rank moment reduction
+because the frameworks' BN is process-local.
+
+On TPU this is nearly free: under GSPMD ``jit`` plain ``nn.BatchNorm``
+already sees the *global* batch (the program is one logical computation),
+and under ``shard_map`` flax BN accepts ``axis_name`` and psums the moments
+itself.  This module exists for API parity and to pin the axis default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...parallel.mesh import AXIS_DATA
+
+
+class SyncBatchNorm(nn.Module):
+    """``nn.BatchNorm`` that reduces moments over the data axis when run
+    inside ``shard_map``; drop-in for the reference's
+    ``SyncBatchNormalization``."""
+
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    axis_name: Union[str, Sequence[str], None] = AXIS_DATA
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        return nn.BatchNorm(
+            use_running_average=self.use_running_average
+            if use_running_average is None else use_running_average,
+            momentum=self.momentum, epsilon=self.epsilon, dtype=self.dtype,
+            param_dtype=jnp.float32, axis_name=self.axis_name,
+            name="bn")(x)
+
+
+SyncBatchNormalization = SyncBatchNorm  # reference class name

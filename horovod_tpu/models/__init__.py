@@ -1,0 +1,30 @@
+"""Model zoo for the benchmark/example surface.
+
+The reference ships models only as examples (Keras ResNet50 in
+`examples/tensorflow2/tensorflow2_synthetic_benchmark.py`, torchvision
+resnet50 in `examples/pytorch/pytorch_synthetic_benchmark.py`, MNIST nets in
+`examples/keras/keras_mnist.py`) — the models come from the frameworks.
+Here they are first-class, TPU-shaped (bfloat16-friendly, static shapes,
+MXU-sized matmuls):
+
+- :mod:`.mlp` — MNIST-scale MLP (the keras_mnist example analog);
+- :mod:`.resnet` — ResNet-50 v1.5, the flagship benchmark model
+  (BASELINE.md: ResNet-50 images/sec/chip);
+- :mod:`.transformer` — encoder (BERT-large preset for the Adasum
+  BERT-pretraining config) and decoder (GPT preset) with pluggable
+  attention: full, ring (sequence-parallel long context), Ulysses;
+  optional MoE FFN;
+- :mod:`.training` — sharded train-step builders wiring models to the
+  ``parallel`` layer and optax.
+"""
+
+from .mlp import MLP  # noqa: F401
+from .resnet import ResNet, ResNet18, ResNet50, ResNet101  # noqa: F401
+from .transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+    bert_large_config,
+    gpt_small_config,
+    tiny_config,
+)
+from .training import TrainState, make_sharded_train_step  # noqa: F401

@@ -1,0 +1,23 @@
+"""MNIST-scale MLP — the `examples/keras/keras_mnist.py` analog."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Flatten → dense stack → logits."""
+
+    features: Sequence[int] = (512, 512)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for width in self.features:
+            x = nn.Dense(width)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

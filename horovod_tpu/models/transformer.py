@@ -1,0 +1,169 @@
+"""Transformer encoder/decoder — BERT-large and GPT presets.
+
+Targets the reference's BERT-large Adasum pretraining config (BASELINE.md
+benchmark 4) and serves as the long-context flagship.  TPU-first choices:
+
+- bfloat16 activations, fp32 params/layernorm/softmax accumulation;
+- tensor parallelism by construction: qkv/FFN kernels carry
+  ``nn.with_partitioning`` annotations over the ``model`` mesh axis
+  (Megatron-style column→row sharding) so ``jit`` + GSPMD inserts the
+  collectives — no hand-written TP code;
+- pluggable attention: ``full`` (XLA-fused, for jit/GSPMD mode), ``ring``
+  (:func:`horovod_tpu.parallel.ring_attention`) or ``ulysses``
+  (:func:`horovod_tpu.parallel.ulysses_attention`) for sequence-parallel
+  long context — the latter two run inside ``shard_map`` with the ``seq``
+  axis bound (see :mod:`horovod_tpu.models.training`);
+- optional ``lax.scan``-friendly uniform blocks + remat for HBM headroom.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import AXIS_MODEL, AXIS_SEQ
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_len: int = 512
+    causal: bool = True               # decoder (GPT); False = encoder (BERT)
+    attention: str = "full"           # full | ring | ulysses
+    seq_axis: str = AXIS_SEQ
+    model_axis: str = AXIS_MODEL
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def bert_large_config(**overrides) -> TransformerConfig:
+    """BERT-large (the reference's Adasum pretraining benchmark model)."""
+    return TransformerConfig(**{**dict(
+        vocab_size=30522, num_layers=24, num_heads=16, d_model=1024,
+        d_ff=4096, max_len=512, causal=False), **overrides})
+
+
+def gpt_small_config(**overrides) -> TransformerConfig:
+    return TransformerConfig(**{**dict(
+        vocab_size=50257, num_layers=12, num_heads=12, d_model=768,
+        d_ff=3072, max_len=1024, causal=True), **overrides})
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    """For tests and the multichip dryrun: tiny shapes, same code paths."""
+    return TransformerConfig(**{**dict(
+        vocab_size=128, num_layers=2, num_heads=4, d_model=32,
+        d_ff=64, max_len=64, causal=True), **overrides})
+
+
+def _dense(cfg: TransformerConfig, features: int, kernel_spec, name: str):
+    """Dense with a TP partitioning annotation on the kernel."""
+    return nn.Dense(
+        features, dtype=cfg.dtype, param_dtype=jnp.float32, name=name,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(0.02), kernel_spec))
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        h, dh = cfg.num_heads, cfg.head_dim
+        # Column-parallel qkv: heads split over the model axis.
+        qkv = _dense(cfg, 3 * h * dh, (None, cfg.model_axis), "qkv")(x)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, dh), 3, axis=2)
+
+        if cfg.attention == "ring":
+            from ..parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=cfg.seq_axis,
+                                 causal=cfg.causal)
+        elif cfg.attention == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v, axis_name=cfg.seq_axis,
+                                    causal=cfg.causal)
+        elif cfg.attention == "full":
+            scale = dh ** -0.5
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            if cfg.causal:
+                mask = jnp.tril(jnp.ones((s, s), bool))
+                scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        else:
+            raise ValueError(f"unknown attention mode {cfg.attention!r}")
+
+        out = out.reshape(b, s, h * dh)
+        # Row-parallel output projection closes the TP pair.
+        return _dense(cfg, cfg.d_model, (cfg.model_axis, None), "out")(out)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)  # noqa: E731
+        x = x + Attention(cfg, name="attn")(ln("ln1")(x))
+        y = _dense(cfg, cfg.d_ff, (None, cfg.model_axis), "ffn_in")(ln("ln2")(x))
+        y = nn.gelu(y)
+        y = _dense(cfg, cfg.d_model, (cfg.model_axis, None), "ffn_out")(y)
+        return x + y
+
+
+class Transformer(nn.Module):
+    """Token ids ``[batch, seq]`` → logits ``[batch, seq, vocab]``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="embed",
+            embedding_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), (cfg.model_axis, None)))
+        pos_embed = self.param(
+            "pos_embed",
+            nn.with_partitioning(nn.initializers.normal(0.02), (None, None)),
+            (cfg.max_len, cfg.d_model), jnp.float32)
+
+        s = tokens.shape[1]
+        if cfg.attention in ("ring", "ulysses"):
+            # Inside shard_map the local shard sees only its sequence slice;
+            # index positions globally.
+            from jax import lax
+
+            start = lax.axis_index(cfg.seq_axis) * s
+            pos = lax.dynamic_slice_in_dim(jnp.asarray(pos_embed), start, s, 0)
+        else:
+            pos = jnp.asarray(pos_embed)[:s]
+
+        x = embed(tokens) + pos.astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Weight-tied readout against the (model-axis-sharded) embedding.
+        return embed.attend(x.astype(jnp.float32))

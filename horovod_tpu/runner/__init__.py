@@ -1,0 +1,83 @@
+"""Launcher layer (reference L5, ``horovod/runner``).
+
+- :mod:`.launch` — the ``hvdrun`` CLI (reference ``horovodrun``);
+- :mod:`.hosts` — host parsing + slot/rank assignment;
+- :mod:`.rendezvous` — the HTTP KV rendezvous server;
+- :mod:`.config_parser` — CLI/YAML → ``HOROVOD_*`` env mapping;
+- :func:`run` — programmatic API (reference ``horovod.run()``,
+  ``runner/__init__.py:92``): pickle a function, run it on ``np``
+  processes, return the per-rank results.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Any, List, Optional
+
+
+def run(func, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 1, hosts: Optional[str] = None,
+        use_env: Optional[dict] = None, verbose: bool = False,
+        timeout: Optional[float] = None) -> List[Any]:
+    """Execute ``func(*args, **kwargs)`` on ``np`` worker processes and
+    return ``[rank0_result, rank1_result, ...]``.
+
+    Local-machine only (workers are subprocesses); ``timeout`` bounds total
+    execution and is unlimited by default — user functions may train for
+    hours.  For multi-host jobs use the ``hvdrun`` CLI's ssh path."""
+    try:
+        import cloudpickle as pickler
+    except ImportError:  # pragma: no cover
+        import pickle as pickler
+
+    from .hosts import get_host_assignments, parse_hosts
+    from .launch import _is_local, _slot_env
+    from .rendezvous import RendezvousServer
+    from .run_task import FUNC_SCOPE, RESULT_SCOPE
+
+    slots = get_host_assignments(
+        parse_hosts(hosts or f"localhost:{np}"), np)
+    remote = sorted({s.hostname for s in slots if not _is_local(s.hostname)})
+    if remote:
+        raise ValueError(
+            f"horovod_tpu.runner.run() executes on the local machine only; "
+            f"remote hosts {remote} need the hvdrun CLI (ssh launch)")
+
+    server = RendezvousServer(bind_addr="127.0.0.1")
+    port = server.start()
+    server.set(FUNC_SCOPE, "payload",
+               pickler.dumps((func, args, kwargs or {})))
+    procs = []
+    try:
+        for slot in slots:
+            env = _slot_env(slot, "127.0.0.1", port, use_env or {})
+            # Workers inherit our stdio when verbose; otherwise output is
+            # discarded — never PIPE-without-drain (a chatty worker would
+            # block on a full pipe buffer).
+            sink = None if verbose else subprocess.DEVNULL
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.runner.run_task"],
+                env=env, text=True, stdout=sink, stderr=sink))
+        for p in procs:
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise TimeoutError(
+                    f"worker did not finish within {timeout}s")
+        results: List[Any] = []
+        for r in range(np):
+            payload = server.get(RESULT_SCOPE, str(r))
+            if payload is None:
+                raise RuntimeError(f"rank {r} produced no result "
+                                   f"(exit {procs[r].returncode})")
+            result, error = pickler.loads(payload)
+            if error is not None:
+                raise error
+            results.append(result)
+        return results
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

@@ -1,0 +1,66 @@
+"""CLI-flag / YAML-config → HOROVOD_* env mapping.
+
+Reference: ``runner/common/util/config_parser.py:1-202`` — every runtime
+tunable has a CLI flag, a YAML config key, and an env var; flags win over
+the config file, and both become env vars exported to every worker.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..common import env
+
+# (args attribute, yaml key, env var, transform)
+_MB = 1024 * 1024
+_PARAMS = [
+    ("fusion_threshold_mb", "fusion-threshold-mb", env.HOROVOD_FUSION_THRESHOLD,
+     lambda v: str(int(float(v) * _MB))),
+    ("cycle_time_ms", "cycle-time-ms", env.HOROVOD_CYCLE_TIME, str),
+    ("cache_capacity", "cache-capacity", env.HOROVOD_CACHE_CAPACITY, str),
+    ("timeline_filename", "timeline-filename", env.HOROVOD_TIMELINE, str),
+    ("timeline_mark_cycles", "timeline-mark-cycles",
+     env.HOROVOD_TIMELINE_MARK_CYCLES, lambda v: "1" if v else "0"),
+    ("no_stall_check", "no-stall-check", env.HOROVOD_STALL_CHECK_DISABLE,
+     lambda v: "1" if v else "0"),
+    ("stall_check_warning_time_seconds", "stall-check-warning-time-seconds",
+     env.HOROVOD_STALL_CHECK_TIME_SECONDS, str),
+    ("stall_check_shutdown_time_seconds", "stall-check-shutdown-time-seconds",
+     env.HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, str),
+    ("autotune", "autotune", env.HOROVOD_AUTOTUNE, lambda v: "1" if v else "0"),
+    ("autotune_log_file", "autotune-log-file", env.HOROVOD_AUTOTUNE_LOG, str),
+    ("autotune_warmup_samples", "autotune-warmup-samples",
+     env.HOROVOD_AUTOTUNE_WARMUP_SAMPLES, str),
+    ("autotune_steps_per_sample", "autotune-steps-per-sample",
+     env.HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, str),
+    ("log_level", "log-level", env.HOROVOD_LOG_LEVEL, str),
+    ("mesh_axes", "mesh-axes", env.HOROVOD_TPU_MESH_AXES, str),
+    ("data_plane", "data-plane", env.HOROVOD_DATA_PLANE, str),
+]
+
+
+def env_from_args(args) -> Dict[str, str]:
+    """Collect HOROVOD_* env from parsed CLI args (unset/None/False flags
+    are omitted so user env and defaults still apply)."""
+    out: Dict[str, str] = {}
+    for attr, _, var, transform in _PARAMS:
+        val = getattr(args, attr, None)
+        if val not in (None, False, ""):
+            out[var] = transform(val)
+    return out
+
+
+def apply_config_file(args, path: Optional[str]) -> None:
+    """Overlay YAML config onto unset args (flags win — reference
+    ``launch.py:293-296,513-517``)."""
+    if not path:
+        return
+    try:
+        import yaml
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("--config-file requires pyyaml") from e
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    for attr, key, _, _ in _PARAMS:
+        if getattr(args, attr, None) in (None, False, "") and key in cfg:
+            setattr(args, attr, cfg[key])

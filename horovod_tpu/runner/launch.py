@@ -1,0 +1,245 @@
+"""``hvdrun`` — the launcher CLI (reference ``horovodrun``).
+
+Reference: ``runner/launch.py:1-776`` — parse args, check hosts, start the
+rendezvous server, compute slot assignments, export per-slot env, exec the
+user command once per slot (ssh for remote hosts), stream output.
+
+TPU-first differences: no mpirun/jsrun backends (the data plane is XLA, the
+control plane our own TCP mesh), and single-host multi-chip needs no ssh at
+all.  Remote hosts use plain ssh like the reference's gloo path
+(``gloo_run.py:133-183``).
+
+Usage::
+
+    python -m horovod_tpu.runner.launch -np 4 python train.py
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from ..common import env as env_mod
+from . import config_parser
+from .hosts import SlotInfo, get_host_assignments, parse_host_files, parse_hosts
+from .rendezvous import RendezvousServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu job (reference: horovodrun).")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="number of worker processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host list like "h1:4,h2:4"; default localhost:np')
+    p.add_argument("--hostfile", default=None,
+                   help="file with one 'host slots=N' per line")
+    p.add_argument("--output-filename", default=None,
+                   help="tee each rank's output into <dir>/rank.N/stdout|stderr")
+    p.add_argument("--verbose", "-v", action="count", default=0)
+    p.add_argument("--start-timeout", type=int, default=120)
+    p.add_argument("--config-file", default=None,
+                   help="YAML file whose keys mirror the CLI flags")
+    # runtime tunables (become HOROVOD_* env; reference launch.py:304-475)
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true", default=False)
+    p.add_argument("--no-stall-check", action="store_true", default=False)
+    p.add_argument("--stall-check-warning-time-seconds", type=int, default=None)
+    p.add_argument("--stall-check-shutdown-time-seconds", type=int, default=None)
+    p.add_argument("--autotune", action="store_true", default=False)
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--log-level", default=None,
+                   choices=["trace", "debug", "info", "warning", "error"])
+    p.add_argument("--mesh-axes", default=None,
+                   help='TPU mesh axes, e.g. "dp:4,tp:2"')
+    p.add_argument("--data-plane", default=None, choices=["xla", "tcp", "auto"])
+    # elastic (wired by horovod_tpu.elastic)
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--reset-limit", type=int, default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command to run on every slot")
+    return p
+
+
+def _slot_env(slot: SlotInfo, rdv_addr: str, rdv_port: int,
+              extra: Dict[str, str]) -> Dict[str, str]:
+    env = os.environ.copy()
+    env.update(slot.to_env())
+    env.update({
+        env_mod.HOROVOD_RENDEZVOUS_ADDR: rdv_addr,
+        env_mod.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
+        env_mod.HOROVOD_CONTROLLER: "tcp",
+    })
+    env.update(extra)
+    # Make horovod_tpu importable in workers regardless of their cwd /
+    # script location (the reference relies on pip-installation instead).
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    parts = env.get("PYTHONPATH", "").split(os.pathsep)
+    if pkg_parent not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_parent] + [p for p in parts if p])
+    return env
+
+
+def _is_local(hostname: str) -> bool:
+    import socket
+
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+def _ssh_command(slot: SlotInfo, command: List[str],
+                 env: Dict[str, str]) -> List[str]:
+    """Remote slot: carry HOROVOD_*/PYTHON* env through ssh explicitly
+    (reference ``gloo_run.py:133-183`` builds the same kind of line)."""
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env.items()
+        if k.startswith("HOROVOD_") or k in ("PYTHONPATH", "PATH"))
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+        " ".join(shlex.quote(c) for c in command)
+    return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote]
+
+
+class _OutputPump(threading.Thread):
+    """Forward a worker stream line-by-line with a rank prefix, optionally
+    teeing into --output-filename/rank.N/ files (reference
+    ``gloo_run.py:150-163``)."""
+
+    def __init__(self, stream, sink, prefix: str, tee_path: Optional[str]):
+        super().__init__(daemon=True)
+        self._stream = stream
+        self._sink = sink
+        self._prefix = prefix
+        self._tee = open(tee_path, "w") if tee_path else None
+        self.start()
+
+    def run(self):
+        try:
+            for line in self._stream:
+                self._sink.write(f"{self._prefix}{line}")
+                self._sink.flush()
+                if self._tee:
+                    self._tee.write(line)
+                    self._tee.flush()
+        finally:
+            if self._tee:
+                self._tee.close()
+
+
+def launch_job(args, command: List[str]) -> int:
+    hosts_str = args.hosts
+    if args.hostfile:
+        hosts_str = parse_host_files(args.hostfile)
+    if not hosts_str:
+        hosts_str = f"localhost:{args.num_proc}"
+    slots = get_host_assignments(parse_hosts(hosts_str), args.num_proc)
+
+    server = RendezvousServer(bind_addr="0.0.0.0")
+    port = server.start()
+    server.publish_slots([{
+        "hostname": s.hostname, "rank": s.rank, "local_rank": s.local_rank,
+        "cross_rank": s.cross_rank, "size": s.size,
+        "local_size": s.local_size, "cross_size": s.cross_size,
+    } for s in slots])
+
+    from ..transport.tcp import _default_advertise_addr
+
+    any_remote = any(not _is_local(s.hostname) for s in slots)
+    rdv_addr = _default_advertise_addr() if any_remote else "127.0.0.1"
+    extra = config_parser.env_from_args(args)
+
+    procs: List[subprocess.Popen] = []
+    pumps: List[_OutputPump] = []
+    try:
+        for slot in slots:
+            env = _slot_env(slot, rdv_addr, port, extra)
+            if _is_local(slot.hostname):
+                cmd = command
+            else:
+                cmd = _ssh_command(slot, command, env)
+            proc = subprocess.Popen(
+                cmd, env=env, text=True, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE)
+            procs.append(proc)
+            if args.output_filename:
+                rank_dir = os.path.join(args.output_filename,
+                                        f"rank.{slot.rank}")
+                os.makedirs(rank_dir, exist_ok=True)
+                out_t = os.path.join(rank_dir, "stdout")
+                err_t = os.path.join(rank_dir, "stderr")
+            else:
+                out_t = err_t = None
+            prefix = f"[{slot.rank}]<stdout>: " if args.verbose else ""
+            eprefix = f"[{slot.rank}]<stderr>: " if args.verbose else ""
+            pumps.append(_OutputPump(proc.stdout, sys.stdout, prefix, out_t))
+            pumps.append(_OutputPump(proc.stderr, sys.stderr, eprefix, err_t))
+
+        # Poll ALL workers (not ordered wait): a crash in any rank must
+        # tear the job down even while earlier ranks hang in collectives.
+        exit_code: Optional[int] = None
+        import time as _time
+
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed and exit_code is None:
+                exit_code = failed[0]
+                # One dead worker hangs the rest (collectives block) —
+                # terminate the job like the reference launcher does.
+                for p in procs:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+            if all(c is not None for c in codes):
+                if exit_code is None:
+                    exit_code = 0
+                break
+            _time.sleep(0.1)
+        for pump in pumps:
+            pump.join(timeout=5)
+        return exit_code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    config_parser.apply_config_file(args, args.config_file)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    if args.host_discovery_script or (args.min_np is not None):
+        try:
+            from ..elastic.launcher import launch_elastic_job
+        except ImportError as e:
+            print(f"hvdrun: elastic mode unavailable: {e}", file=sys.stderr)
+            return 2
+        return launch_elastic_job(args, command)
+    return launch_job(args, command)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -89,8 +89,8 @@ class _KVServer(ThreadingHTTPServer):
         return self._store.get(scope, key)
 
     def store_delete(self, scope: str, key: str) -> bool:
-        existed = self._store.get(scope, key) is not None
-        self._store.delete(scope, key)
+        # Atomic pop: concurrent DELETEs must fire the hook exactly once.
+        existed = self._store.pop(scope, key) is not None
         if existed and self._delete_hook is not None:
             self._delete_hook(scope, key)
         return existed

@@ -1,0 +1,43 @@
+"""Worker-side stub for the programmatic ``run()`` API.
+
+Reference: ``runner/run_task.py:1-37`` — each worker fetches the
+cloudpickled user function from the launcher's KV store, executes it with
+the runtime initialized, and PUTs the pickled result back under its rank.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+FUNC_SCOPE = "exec_func"
+RESULT_SCOPE = "exec_result"
+
+
+def main() -> int:
+    try:
+        import cloudpickle as pickler
+    except ImportError:  # pragma: no cover
+        import pickle as pickler
+
+    from ..common import env as env_mod
+    from ..transport.store import HTTPStoreClient
+
+    addr = os.environ[env_mod.HOROVOD_RENDEZVOUS_ADDR]
+    port = int(os.environ[env_mod.HOROVOD_RENDEZVOUS_PORT])
+    rank = os.environ.get(env_mod.HOROVOD_RANK, "0")
+    store = HTTPStoreClient(addr, port)
+    func, args, kwargs = pickler.loads(store.wait(
+        FUNC_SCOPE, ["payload"], timeout=60)["payload"])
+
+    result, error = None, None
+    try:
+        result = func(*args, **kwargs)
+    except BaseException as e:  # noqa: BLE001
+        error = e
+    store.set(RESULT_SCOPE, rank, pickler.dumps((result, error)))
+    return 1 if error is not None else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

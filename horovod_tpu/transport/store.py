@@ -75,8 +75,13 @@ class MemoryStore(Store):
             return self._data.get(f"{scope}/{key}")
 
     def delete(self, scope: str, key: str) -> None:
+        self.pop(scope, key)
+
+    def pop(self, scope: str, key: str) -> Optional[bytes]:
+        """Atomic check-and-delete (one lock) — callers that need to know
+        whether the key existed must use this, not get()+delete()."""
         with self._cv:
-            self._data.pop(f"{scope}/{key}", None)
+            return self._data.pop(f"{scope}/{key}", None)
 
 
 class HTTPStoreClient(Store):

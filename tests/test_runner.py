@@ -1,0 +1,97 @@
+"""Launcher tests: slot-assignment math (reference `test/single/test_run.py`
+style) + a real end-to-end `hvdrun` launch with 2 local workers."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner.hosts import (
+    HostInfo,
+    get_host_assignments,
+    parse_hosts,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_hosts():
+    hosts = parse_hosts("a:4, b:2,c")
+    assert hosts == [HostInfo("a", 4), HostInfo("b", 2), HostInfo("c", 1)]
+
+
+def test_host_assignments_homogeneous():
+    slots = get_host_assignments(parse_hosts("a:2,b:2"), 4)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank) for s in slots] == [
+        ("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+    assert all(s.local_size == 2 and s.cross_size == 2 and s.size == 4
+               for s in slots)
+
+
+def test_host_assignments_heterogeneous_cross_scope():
+    slots = get_host_assignments(parse_hosts("a:2,b:1"), 3)
+    by_rank = {s.rank: s for s in slots}
+    # local_rank 0 exists on both hosts -> cross_size 2
+    assert by_rank[0].cross_size == 2 and by_rank[2].cross_size == 2
+    # local_rank 1 exists only on host a -> cross scope of size 1
+    assert by_rank[1].cross_size == 1 and by_rank[1].cross_rank == 0
+
+
+def test_host_assignments_insufficient_slots():
+    with pytest.raises(ValueError):
+        get_host_assignments(parse_hosts("a:1"), 2)
+
+
+def test_hvdrun_end_to_end(tmp_path):
+    """Real launch: 2 local workers allreduce through the full stack."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        out = hvd.allreduce(np.full(3, float(hvd.rank() + 1)), op=hvd.Sum)
+        assert np.allclose(np.asarray(out), 3.0), out
+        print("LAUNCHED_OK", hvd.rank(), flush=True)
+        hvd.shutdown()
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--output-filename", str(tmp_path / "logs"),
+         sys.executable, str(script)],
+        cwd=REPO_ROOT, text=True, capture_output=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "LAUNCHED_OK 0" in proc.stdout and "LAUNCHED_OK 1" in proc.stdout
+    # --output-filename tee
+    assert (tmp_path / "logs" / "rank.0" / "stdout").exists()
+
+
+def test_hvdrun_propagates_failure(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text(
+        "import horovod_tpu as hvd\nhvd.init()\n"
+        "import sys\nsys.exit(3 if hvd.rank() == 1 else 0)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        cwd=REPO_ROOT, text=True, capture_output=True, timeout=120)
+    assert proc.returncode == 3, (proc.returncode, proc.stdout, proc.stderr)
+
+
+def _worker_fn(scale):
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = hvd.allreduce(np.ones(2) * (hvd.rank() + 1), op=hvd.Sum)
+    hvd.shutdown()
+    return float(out[0]) * scale
+
+
+def test_programmatic_run():
+    import horovod_tpu.runner as runner
+
+    results = runner.run(_worker_fn, args=(2.0,), np=2)
+    assert results == [6.0, 6.0], results
