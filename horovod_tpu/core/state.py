@@ -104,7 +104,11 @@ class HorovodGlobalState:
                         "size > 1 requires a rendezvous store "
                         "(HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT, set by the launcher)")
                 store = HTTPStoreClient(addr, port)
-            self.mesh = TcpMesh(topo.rank, topo.size, store)
+            # Epoch-scoped keys so elastic re-init never reads stale peer
+            # addresses from a previous incarnation of the job.
+            epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+            self.mesh = TcpMesh(topo.rank, topo.size, store,
+                                scope=f"tcp.{epoch}")
         fusion = env_mod.get_int(
             env_mod.HOROVOD_FUSION_THRESHOLD, env_mod.DEFAULT_FUSION_THRESHOLD)
         stall_secs = 0 if env_mod.get_bool(env_mod.HOROVOD_STALL_CHECK_DISABLE) \
@@ -267,6 +271,8 @@ class HorovodGlobalState:
             e.callback(status, e)
 
     def _fail_all_pending(self, msg: str) -> None:
+        # Close first: an add racing the drain must fail fast, not strand.
+        self.tensor_queue.close()
         for name in self.tensor_queue.names():
             entry = self.tensor_queue.remove(name)
             if entry is not None:
@@ -287,6 +293,14 @@ class HorovodGlobalState:
                 "horovod_tpu has not been initialized; call hvd.init() first.")
         if self.init_error is not None:
             raise HorovodInternalError(f"initialization failed: {self.init_error}")
+        if self.shutdown_complete.is_set() or \
+                (self.background is not None and not self.background.is_alive()):
+            # The loop died (peer failure / shutdown): enqueues must fail
+            # fast — nothing will ever complete them.  Elastic's run
+            # wrapper turns this into a rollback + re-init.
+            raise HorovodInternalError(
+                "Horovod background loop is not running (shut down or "
+                "failed); reinitialize before submitting collectives")
 
     def enqueue_allreduce(self, name: str, tensor: np.ndarray,
                           callback: Callable[[Status], None],
@@ -366,6 +380,9 @@ class HorovodGlobalState:
                       tensor_name=JOIN_TENSOR_NAME)
         # JOIN carries no tensor entry; push the request directly.
         self.tensor_queue.push_messages([req])
+        if self.shutdown_complete.is_set():
+            # Loop died between the liveness check and the push: unblock.
+            event.set()
         return event
 
     def enqueue_barrier(self, callback: Callable[[Status], None],

@@ -64,15 +64,29 @@ class TensorQueue:
         self._lock = threading.Lock()
         self._table: Dict[str, TensorTableEntry] = {}
         self._pending: List[Request] = []
+        self._closed = False
 
     def add(self, entry: TensorTableEntry, request: Request) -> None:
+        from ..common.exceptions import HorovodInternalError
+
         with self._lock:
+            if self._closed:
+                # The background loop has exited and drained the table; an
+                # add after that point would strand its waiter forever.
+                raise HorovodInternalError(
+                    "Horovod background loop is not running (shut down or "
+                    "failed); reinitialize before submitting collectives")
             if entry.tensor_name in self._table:
                 raise DuplicateNameError(
                     f"tensor {entry.tensor_name!r} already in flight; collective "
                     f"names must be unique until the previous op completes")
             self._table[entry.tensor_name] = entry
             self._pending.append(request)
+
+    def close(self) -> None:
+        """Reject all future adds; called before the final drain."""
+        with self._lock:
+            self._closed = True
 
     def pop_messages(self) -> List[Request]:
         """Drain pending requests (one cycle's worth) —

@@ -1,0 +1,28 @@
+"""Elastic (fault-tolerant, autoscaling) training.
+
+Reference: the three cooperating pieces of SURVEY §5.3 —
+
+- **worker side** (:mod:`.state`): ``State`` objects with
+  commit/restore/sync, the ``@hvd.elastic.run`` wrapper that retries the
+  training function across membership changes
+  (``common/elastic.py:147-168``);
+- **driver side** (:mod:`.driver`, :mod:`.discovery`,
+  :mod:`.registration`): discovery-script polling, host diff + blacklist,
+  stable rank reassignment, worker lifecycle counting
+  (``runner/elastic/driver.py``, ``discovery.py``, ``registration.py``);
+- **notification channel** (:mod:`.worker`): driver→worker host-change
+  pings (``runner/elastic/worker.py``).
+
+TPU deployment note: the discovery script is where pod-slice preemption
+notices surface — a script that lists healthy TPU-VM workers makes
+preemption behave exactly like the reference's host-removal flow.
+"""
+
+from .state import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    JaxState,
+    ObjectState,
+    State,
+    run,
+)

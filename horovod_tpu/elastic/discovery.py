@@ -1,0 +1,108 @@
+"""Host discovery + host-set management for elastic jobs.
+
+Reference: ``runner/elastic/discovery.py:1-164`` — ``HostDiscoveryScript``
+shells out to the user-provided script (one ``host[:slots]`` per line) and
+``HostManager`` diffs successive host sets, maintains the blacklist, and
+orders hosts stably so surviving hosts keep their relative rank order
+across updates (``discovery.py:114-122``).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..common.logging_util import get_logger
+from ..runner.hosts import HostInfo, parse_hosts
+
+log = get_logger("horovod_tpu.elastic.discovery")
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """{hostname: slots} of currently healthy hosts."""
+        raise NotImplementedError
+
+
+class FixedHosts(HostDiscovery):
+    def __init__(self, hosts: List[HostInfo]):
+        self._hosts = {h.hostname: h.slots for h in hosts}
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user script; output = one ``host`` or ``host:slots`` per
+    line (reference ``discovery.py:130-163``).  On TPU deployments the
+    script typically lists non-preempted TPU-VM workers."""
+
+    def __init__(self, script: str, default_slots: int = 1):
+        self._script = script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(self._script, shell=True, text=True,
+                                      timeout=30)
+        hosts: Dict[str, int] = {}
+        for part in out.splitlines():
+            part = part.strip()
+            if not part:
+                continue
+            info = HostInfo.from_string(
+                part if ":" in part else f"{part}:{self._default_slots}")
+            hosts[info.hostname] = info.slots
+        return hosts
+
+
+class HostManager:
+    """Tracks the current host set, stable ordering, and the blacklist
+    (reference ``discovery.py:79-121``)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._order: List[str] = []       # stable rank order
+        self._slots: Dict[str, int] = {}
+        self._blacklist: Set[str] = set()
+
+    def blacklist(self, hostname: str) -> None:
+        with self._lock:
+            if hostname not in self._blacklist:
+                log.warning("blacklisting host %s", hostname)
+                self._blacklist.add(hostname)
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        with self._lock:
+            return hostname in self._blacklist
+
+    @property
+    def current_hosts(self) -> List[HostInfo]:
+        with self._lock:
+            return [HostInfo(h, self._slots[h]) for h in self._order]
+
+    def update_available_hosts(self) -> Tuple[bool, bool]:
+        """Polls discovery; returns (changed, removal_or_failure).
+
+        Ordering rule: surviving hosts keep their existing positions, new
+        hosts append — rank assignments stay stable across growth."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            found = {h: s for h, s in found.items()
+                     if h not in self._blacklist}
+            removed = [h for h in self._order if h not in found]
+            added = [h for h in found if h not in self._slots]
+            slots_changed = any(
+                h in self._slots and self._slots[h] != s
+                for h, s in found.items())
+            changed = bool(removed or added or slots_changed)
+            new_order = [h for h in self._order if h in found]
+            new_order.extend(h for h in found if h not in new_order)
+            self._order = new_order
+            self._slots = found
+            return changed, bool(removed or slots_changed)
+
+    def total_slots(self) -> int:
+        with self._lock:
+            return sum(self._slots.values())

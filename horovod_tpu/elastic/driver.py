@@ -1,0 +1,232 @@
+"""The elastic driver: discovery loop, membership epochs, worker lifecycle.
+
+Reference: ``runner/elastic/driver.py:1-309`` — a background thread polls
+discovery every second (``DISCOVER_HOSTS_FREQUENCY_SECS``), host-set diffs
+trigger worker notification + a new rendezvous epoch, failed workers
+blacklist their host after repeated failures, and rank assignments stay
+stable for surviving hosts (``_update_host_assignments``).
+
+Membership protocol (epoch-based, coordinator-authoritative like the rest
+of this framework):
+
+1. every epoch the driver publishes a slot table (rank/local/cross + epoch)
+   under ``rank_and_size/{hostname}:{local_rank}``;
+2. workers (re)initialize from their identity's entry; removed identities
+   see ``rank: -1`` and exit;
+3. on change: epoch += 1, publish, notify live workers (they raise
+   ``HostsUpdatedInterrupt`` at the next commit), spawn processes for new
+   identities;
+4. worker process death ⇒ failure recorded; a host whose workers keep
+   dying is blacklisted; remaining workers hit ``HorovodInternalError``
+   (broken TCP mesh) and re-rendezvous into the next epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..common.logging_util import get_logger
+from ..runner.hosts import SlotInfo, get_host_assignments
+from ..runner.rendezvous import RendezvousServer
+from .discovery import HostManager
+from .registration import WorkerStateRegistry
+from .worker import WORKERS_SCOPE, WorkerNotificationClient
+
+log = get_logger("horovod_tpu.elastic.driver")
+
+DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
+ELASTIC_TIMEOUT_SECS = 600.0
+
+
+class ElasticDriver:
+    def __init__(self, rendezvous: RendezvousServer, host_manager: HostManager,
+                 min_np: int, max_np: Optional[int] = None,
+                 reset_limit: Optional[int] = None,
+                 timeout: float = ELASTIC_TIMEOUT_SECS):
+        self.rendezvous = rendezvous
+        self.hosts = host_manager
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.timeout = timeout
+        self.epoch = 0
+        self.resets = 0
+        self._slots: List[SlotInfo] = []
+        self._known_identities: Dict[str, SlotInfo] = {}
+        self._create_worker: Optional[Callable[[SlotInfo, int], None]] = None
+        self._registry = WorkerStateRegistry(0)
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._wakeup = threading.Event()
+        self._discovery_thread: Optional[threading.Thread] = None
+        self._await_ack: Optional[bool] = None  # added_only flavor, or None
+        self._removed_identities: set = set()
+
+    # ------------------------------------------------------------------
+
+    def wait_for_available_slots(self, min_np: Optional[int] = None) -> None:
+        """Block until discovery provides enough slots
+        (reference ``driver.py:145``)."""
+        need = min_np or self.min_np
+        deadline = time.monotonic() + self.timeout
+        while True:
+            self.hosts.update_available_hosts()
+            if self.hosts.total_slots() >= need:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {need} slots "
+                    f"(have {self.hosts.total_slots()})")
+            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def start(self, create_worker: Callable[[SlotInfo, int], None]) -> None:
+        """Publish epoch 0 assignments, spawn workers, start discovery."""
+        self._create_worker = create_worker
+        self.wait_for_available_slots()
+        self._rendezvous_epoch(initial=True)
+        self._discovery_thread = threading.Thread(
+            target=self._discovery_loop, name="hvd-elastic-discovery",
+            daemon=True)
+        self._discovery_thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._wakeup.set()
+
+    # ------------------------------------------------------------------
+
+    def _assignments(self) -> List[SlotInfo]:
+        hosts = self.hosts.current_hosts
+        total = sum(h.slots for h in hosts)
+        np_ = min(total, self.max_np) if self.max_np else total
+        return get_host_assignments(hosts, min(self.min_np, np_), np_)
+
+    def _rendezvous_epoch(self, initial: bool = False) -> None:
+        with self._lock:
+            if not initial:
+                self.epoch += 1
+                self.resets += 1
+            new_slots = self._assignments()
+            self._slots = new_slots
+            self._registry.reset(len(new_slots))
+
+            # Publish the new table; removed identities get rank -1 so a
+            # surviving process on a removed host exits cleanly.
+            table = {}
+            for s in new_slots:
+                table[f"{s.hostname}:{s.local_rank}"] = {
+                    "hostname": s.hostname, "rank": s.rank,
+                    "local_rank": s.local_rank, "cross_rank": s.cross_rank,
+                    "size": s.size, "local_size": s.local_size,
+                    "cross_size": s.cross_size, "epoch": self.epoch,
+                }
+            for identity in self._known_identities:
+                if identity not in table:
+                    host, lr = identity.rsplit(":", 1)
+                    table[identity] = {
+                        "hostname": host, "rank": -1, "local_rank": int(lr),
+                        "cross_rank": -1, "size": 0, "local_size": 0,
+                        "cross_size": 0, "epoch": self.epoch,
+                    }
+            for identity, slot in table.items():
+                self.rendezvous.set("rank_and_size", identity,
+                                    json.dumps(slot).encode())
+
+            # Spawn processes for identities that have none yet.
+            for s in new_slots:
+                identity = f"{s.hostname}:{s.local_rank}"
+                if identity not in self._known_identities:
+                    log.info("spawning worker %s (epoch %d, rank %d)",
+                             identity, self.epoch, s.rank)
+                    self._create_worker(s, self.epoch)
+                self._known_identities[identity] = s
+            current = {f"{s.hostname}:{s.local_rank}" for s in new_slots}
+            self._removed_identities = {
+                i for i in self._known_identities if i not in current}
+            for identity in self._removed_identities:
+                self._known_identities.pop(identity)
+
+    def _notify_workers(self, added_only: bool) -> None:
+        addresses = []
+        missing = []
+        # Removed identities are notified too: their table entry says
+        # rank −1, and the ping is what makes them exit promptly instead
+        # of waiting to hit a dead socket.
+        identities = {f"{s.hostname}:{s.local_rank}" for s in self._slots}
+        identities.update(self._removed_identities)
+        for identity in sorted(identities):
+            raw = self.rendezvous.get(WORKERS_SCOPE, identity)
+            if raw:
+                addresses.append(raw.decode())
+            else:
+                missing.append(identity)
+        log.info("notifying %d workers of host change (unregistered: %s)",
+                 len(addresses), missing or "none")
+        WorkerNotificationClient(addresses).notify_hosts_updated(added_only)
+
+    def _discovery_loop(self) -> None:
+        while not self._shutdown.is_set():
+            self._wakeup.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+            self._wakeup.clear()
+            if self._shutdown.is_set():
+                return
+            self._renotify_unacked()
+            try:
+                changed, removal = self.hosts.update_available_hosts()
+            except Exception as e:  # noqa: BLE001 — discovery script hiccups
+                log.warning("host discovery failed: %s", e)
+                continue
+            if not changed:
+                continue
+            if self.reset_limit is not None and \
+                    self.resets >= self.reset_limit:
+                log.error("reset limit %d reached; ignoring host change",
+                          self.reset_limit)
+                continue
+            if self.hosts.total_slots() < self.min_np:
+                log.warning("host change leaves fewer than min_np slots; "
+                            "waiting for capacity")
+                continue
+            log.info("host set changed (removal=%s); advancing epoch",
+                     removal)
+            self._rendezvous_epoch()
+            self._await_ack = not removal  # remember flavor for re-notify
+            self._notify_workers(added_only=not removal)
+
+    # ------------------------------------------------------------------
+
+    def _renotify_unacked(self) -> None:
+        """Notification is racy against worker startup (a worker may
+        register its endpoint just after a change fired).  Until every
+        current identity acks the epoch, keep pinging each tick."""
+        if self._await_ack is None or self.epoch == 0:
+            return
+        unacked = []
+        for s in self._slots:
+            identity = f"{s.hostname}:{s.local_rank}"
+            raw = self.rendezvous.get("epoch_ack", identity)
+            if raw is None or int(raw.decode()) < self.epoch:
+                unacked.append(identity)
+        if not unacked:
+            self._await_ack = None
+            return
+        self._notify_workers(added_only=self._await_ack)
+
+    def record_worker_exit(self, slot: SlotInfo, exit_code: int) -> None:
+        """Called by the launcher's process monitor (reference
+        ``_handle_worker_exit``, ``driver.py:292-308``)."""
+        if exit_code == 0:
+            self._registry.record_success(slot.rank)
+            return
+        self._registry.record_failure(slot.rank)
+        self.hosts.blacklist(slot.hostname)
+        self._known_identities.pop(f"{slot.hostname}:{slot.local_rank}", None)
+        self._wakeup.set()
+
+    @property
+    def current_slots(self) -> List[SlotInfo]:
+        with self._lock:
+            return list(self._slots)

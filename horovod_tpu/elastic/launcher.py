@@ -1,0 +1,119 @@
+"""Elastic launch: wires ElasticDriver into the ``hvdrun`` CLI.
+
+Reference: ``runner/gloo_run.py:287-336`` (``launch_gloo_elastic``) — start
+the rendezvous server, build discovery from the script (or fixed hosts),
+spawn a worker per slot with elastic env, monitor exits, and finish when
+the surviving workers complete.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List
+
+from ..common import env as env_mod
+from ..common.logging_util import get_logger
+from ..runner import config_parser
+from ..runner.hosts import SlotInfo, parse_host_files, parse_hosts
+from ..runner.launch import _is_local, _ssh_command, _slot_env, _OutputPump
+from ..runner.rendezvous import RendezvousServer
+from .discovery import FixedHosts, HostDiscoveryScript, HostManager
+from .driver import ElasticDriver
+from .registration import FAILURE, SUCCESS
+
+log = get_logger("horovod_tpu.elastic.launcher")
+
+
+def launch_elastic_job(args, command: List[str]) -> int:
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script)
+    else:
+        hosts_str = args.hosts
+        if args.hostfile:
+            hosts_str = parse_host_files(args.hostfile)
+        if not hosts_str:
+            hosts_str = f"localhost:{args.num_proc}"
+        discovery = FixedHosts(parse_hosts(hosts_str))
+
+    server = RendezvousServer(bind_addr="0.0.0.0")
+    port = server.start()
+    min_np = args.min_np or args.num_proc
+    driver = ElasticDriver(
+        server, HostManager(discovery), min_np=min_np, max_np=args.max_np,
+        reset_limit=args.reset_limit)
+
+    from ..transport.tcp import _default_advertise_addr
+
+    rdv_addr = _default_advertise_addr()
+    extra = config_parser.env_from_args(args)
+    extra[env_mod.HOROVOD_ELASTIC] = "1"
+    if args.reset_limit:
+        extra["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
+
+    procs: Dict[str, subprocess.Popen] = {}
+    pumps: List[_OutputPump] = []
+    lock = threading.Lock()
+
+    def create_worker(slot: SlotInfo, epoch: int) -> None:
+        env = _slot_env(slot, rdv_addr if not _is_local(slot.hostname)
+                        else "127.0.0.1", port, extra)
+        env["HOROVOD_EPOCH"] = str(epoch)
+        cmd = command if _is_local(slot.hostname) \
+            else _ssh_command(slot, command, env)
+        proc = subprocess.Popen(cmd, env=env, text=True,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+        identity = f"{slot.hostname}:{slot.local_rank}"
+        with lock:
+            procs[identity] = proc
+        prefix = f"[{slot.rank}]<stdout>: " if args.verbose else ""
+        eprefix = f"[{slot.rank}]<stderr>: " if args.verbose else ""
+        pumps.append(_OutputPump(proc.stdout, sys.stdout, prefix, None))
+        pumps.append(_OutputPump(proc.stderr, sys.stderr, eprefix, None))
+        threading.Thread(target=_monitor, args=(identity, slot, proc),
+                         daemon=True).start()
+
+    def _monitor(identity: str, slot: SlotInfo, proc: subprocess.Popen):
+        code = proc.wait()
+        with lock:
+            if procs.get(identity) is proc:
+                procs.pop(identity, None)
+        log.info("worker %s exited with %d", identity, code)
+        driver.record_worker_exit(slot, code)
+
+    try:
+        driver.start(create_worker)
+        while True:
+            time.sleep(0.5)
+            with lock:
+                alive = len(procs)
+            successes = driver._registry.count(SUCCESS)
+            failures = driver._registry.count(FAILURE)
+            current = len(driver.current_slots)
+            if successes and successes >= current and alive == 0:
+                return 0
+            if alive == 0 and failures and \
+                    driver.hosts.total_slots() < min_np:
+                log.error("all capacity lost (%d failures)", failures)
+                return 1
+            if driver.reset_limit is not None and \
+                    driver.resets > driver.reset_limit:
+                log.error("elastic reset limit exceeded")
+                return 1
+    finally:
+        driver.stop()
+        with lock:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+        time.sleep(0.2)
+        with lock:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+        server.stop()

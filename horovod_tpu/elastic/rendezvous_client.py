@@ -1,0 +1,68 @@
+"""Worker-side re-rendezvous: fetch the current slot assignment.
+
+Reference: ``gloo_context.cc:154-189`` — on elastic re-init the worker asks
+the rendezvous server's ``rank_and_size`` scope for its new rank/size keyed
+by ``hostname:local_rank``; a removed host gets rank −1 and exits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from ..common import env as env_mod
+from ..common.topology import ProcessTopology
+from ..transport.store import HTTPStoreClient
+
+RANK_AND_SIZE_SCOPE = "rank_and_size"
+
+
+def _identity() -> str:
+    hostname = env_mod.get_str(env_mod.HOROVOD_HOSTNAME) or "localhost"
+    local_rank = env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)
+    return f"{hostname}:{local_rank}"
+
+
+def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
+    """Blocks until the driver publishes a slot table for a NEW epoch, then
+    adopts this process's new coordinates (exits if removed)."""
+    addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        raise RuntimeError("elastic re-init requires a rendezvous server")
+    store = HTTPStoreClient(addr, port)
+    my_epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+
+    deadline = time.monotonic() + timeout
+    while True:
+        raw = store.get(RANK_AND_SIZE_SCOPE, _identity())
+        if raw is not None:
+            slot = json.loads(raw.decode())
+            if slot.get("epoch", 0) > my_epoch:
+                break
+        if time.monotonic() > deadline:
+            raise TimeoutError("no new rendezvous assignment within timeout")
+        time.sleep(0.25)
+
+    # Ack adoption so the driver stops re-notifying this identity.
+    store.set("epoch_ack", _identity(), str(slot["epoch"]).encode())
+
+    if slot["rank"] < 0:
+        # Host was removed from the job (reference exits the worker).
+        sys.exit(0)
+
+    for key, var in [("rank", env_mod.HOROVOD_RANK),
+                     ("size", env_mod.HOROVOD_SIZE),
+                     ("local_rank", env_mod.HOROVOD_LOCAL_RANK),
+                     ("local_size", env_mod.HOROVOD_LOCAL_SIZE),
+                     ("cross_rank", env_mod.HOROVOD_CROSS_RANK),
+                     ("cross_size", env_mod.HOROVOD_CROSS_SIZE)]:
+        os.environ[var] = str(slot[key])
+    os.environ["HOROVOD_EPOCH"] = str(slot["epoch"])
+    return ProcessTopology(
+        rank=slot["rank"], size=slot["size"],
+        local_rank=slot["local_rank"], local_size=slot["local_size"],
+        cross_rank=slot["cross_rank"], cross_size=slot["cross_size"],
+        hostname=slot["hostname"])

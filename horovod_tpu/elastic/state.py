@@ -1,0 +1,231 @@
+"""Worker-side elastic state + the ``@hvd.elastic.run`` wrapper.
+
+Reference: ``common/elastic.py:1-168`` (``State``/``ObjectState``/``run_fn``)
+and ``torch/elastic/state.py:27-178`` (handler-based ``TorchState``).  The
+contract:
+
+- ``state.commit()`` — snapshot to host memory + raise
+  ``HostsUpdatedInterrupt`` if the driver notified us of membership change;
+- ``HorovodInternalError`` (collective failed: peer died) → roll back to
+  the last commit, re-rendezvous, retry;
+- ``HostsUpdatedInterrupt`` (graceful change) → keep state, re-rendezvous,
+  retry;
+- after every reset the coordinator broadcasts its state so new/restored
+  workers agree (``state.sync()``).
+
+``JaxState`` snapshots pytrees (params/opt_state/any arrays) by copying to
+host numpy — cheap, and exactly the commit/rollback semantics the
+reference implements with ``deepcopy`` of torch state dicts.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+_host_update_event = threading.Event()
+_host_update_skip_sync = [True]
+
+
+def notify_hosts_updated(added_only: bool = False) -> None:
+    """Called by the worker notification service when the driver reports a
+    host-set change; surfaces at the next ``commit()``/``check`` point."""
+    _host_update_skip_sync[0] = _host_update_skip_sync[0] and added_only
+    _host_update_event.set()
+
+
+def _consume_host_update() -> Optional[bool]:
+    if _host_update_event.is_set():
+        _host_update_event.clear()
+        skip = _host_update_skip_sync[0]
+        _host_update_skip_sync[0] = True
+        return skip
+    return None
+
+
+class State:
+    """Base elastic state (reference ``common/elastic.py:24-100``)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(self, callbacks: List[Callable[[], None]]) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        skip = _consume_host_update()
+        if skip is not None:
+            raise HostsUpdatedInterrupt(skip_sync=skip)
+
+    # subclass responsibilities -----------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """Arbitrary picklable attributes, synced by coordinator broadcast
+    (reference ``common/elastic.py:103-144``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._known = list(kwargs.keys())
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {k: copy.deepcopy(getattr(self, k)) for k in self._known}
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..frameworks.jax.functions import broadcast_object
+
+        values = {k: getattr(self, k) for k in self._known}
+        synced = broadcast_object(values, root_rank=0, name="elastic.objstate")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Pytree-aware elastic state: array leaves snapshot to host numpy and
+    sync via per-leaf broadcast (cheaper + dtype-exact vs pickling)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def _trees(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._known}
+
+    def save(self) -> None:
+        import jax
+        import numpy as np
+
+        def snap(x):
+            if hasattr(x, "device") or hasattr(x, "sharding"):
+                return np.asarray(jax.device_get(x))
+            return copy.deepcopy(x)
+
+        self._saved = {
+            k: jax.tree_util.tree_map(snap, v) for k, v in self._trees().items()
+        }
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        import jax
+
+        from ..frameworks.jax.functions import broadcast_parameters
+
+        for k in self._known:
+            tree = getattr(self, k)
+            leaves = jax.tree_util.tree_leaves(tree)
+            if leaves and all(hasattr(l, "shape") for l in leaves):
+                setattr(self, k, broadcast_parameters(tree, root_rank=0))
+            else:
+                from ..frameworks.jax.functions import broadcast_object
+
+                setattr(self, k, broadcast_object(
+                    tree, root_rank=0, name=f"elastic.sync.{k}"))
+        self.save()
+
+
+def _reset_and_reinit() -> None:
+    """Full runtime teardown + re-init from the (possibly new) rendezvous
+    assignment — the analog of the reference's shutdown/re-init reset path
+    (``tensorflow/elastic.py:64-67`` + ``gloo_context.cc:154-189``)."""
+    from ..core import state as core_state
+    from ..frameworks.jax import basics
+
+    basics._internal_reset()
+    from .rendezvous_client import refresh_topology_from_rendezvous
+
+    topo = refresh_topology_from_rendezvous()
+    core_state.global_state().initialize(topology=topo)
+
+
+def run(func: Callable) -> Callable:
+    """Decorator: retry ``func(state, ...)`` across membership changes
+    (reference ``run_fn``, ``common/elastic.py:147-168``)."""
+
+    def wrapper(state: State, *args, **kwargs):
+        from ..core.state import global_state
+
+        notification_manager.start()
+        reset_limit = notification_manager.reset_limit
+        resets = 0
+        skip_sync = False
+        while True:
+            if not global_state().initialized.is_set():
+                _reset_and_reinit()
+            try:
+                if not skip_sync:
+                    state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                skip_sync = e.skip_sync
+            resets += 1
+            if reset_limit is not None and resets >= reset_limit:
+                raise RuntimeError(
+                    f"Exceeded elastic reset limit ({reset_limit})")
+            state.on_reset()
+            _reset_and_reinit()
+
+    return wrapper
+
+
+class _NotificationManager:
+    """Lazily starts the worker-side notification server (reference
+    ``elastic/worker.py``: an RPC server the driver pings on host
+    changes)."""
+
+    def __init__(self):
+        self._started = False
+        self.reset_limit: Optional[int] = None
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        from ..common import env as env_mod
+
+        if not env_mod.get_bool(env_mod.HOROVOD_ELASTIC):
+            return
+        from .worker import start_notification_service
+
+        start_notification_service()
+        limit = env_mod.get_int("HOROVOD_ELASTIC_RESET_LIMIT", 0)
+        self.reset_limit = limit if limit > 0 else None
+
+
+notification_manager = _NotificationManager()

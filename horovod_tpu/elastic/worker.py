@@ -1,0 +1,81 @@
+"""Driver↔worker notification channel.
+
+Reference: ``runner/elastic/worker.py:1-110`` — each worker runs a tiny
+notification server; the driver pings it when discovery sees a host-set
+change, and the worker surfaces that as ``HostsUpdatedInterrupt`` at its
+next ``state.commit()``.  Ours is a threaded HTTP server whose address is
+registered in the rendezvous ``workers`` scope.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..common import env as env_mod
+from ..common.logging_util import get_logger
+from ..transport.store import HTTPStoreClient, Store
+
+log = get_logger("horovod_tpu.elastic.worker")
+
+WORKERS_SCOPE = "workers"
+
+
+class _NotifyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def do_POST(self):
+        from .state import notify_hosts_updated
+
+        added_only = self.path.rstrip("/").endswith("added")
+        notify_hosts_updated(added_only=added_only)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+def start_notification_service(store: Optional[Store] = None) -> int:
+    """Start the worker's notify server and register its port; returns the
+    bound port (0 when no rendezvous is configured — single-process runs)."""
+    server = ThreadingHTTPServer(("0.0.0.0", 0), _NotifyHandler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="hvd-elastic-notify").start()
+    port = server.server_address[1]
+
+    if store is None:
+        addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+        srv_port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+        if not addr or not srv_port:
+            return 0
+        store = HTTPStoreClient(addr, srv_port)
+    identity = (f"{env_mod.get_str(env_mod.HOROVOD_HOSTNAME) or 'localhost'}:"
+                f"{env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)}")
+    from ..transport.tcp import _default_advertise_addr
+
+    store.set(WORKERS_SCOPE, identity,
+              f"{_default_advertise_addr()}:{port}".encode())
+    return port
+
+
+class WorkerNotificationClient:
+    """Driver side: ping registered workers about host changes."""
+
+    def __init__(self, addresses: List[str]):
+        self._addresses = addresses
+
+    def notify_hosts_updated(self, added_only: bool) -> None:
+        suffix = "added" if added_only else "changed"
+        for addr in self._addresses:
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/notify/{suffix}", data=b"", method="POST")
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            except OSError as e:
+                log.debug("worker notify %s failed: %s", addr, e)

@@ -49,3 +49,4 @@ from .optimizer import (  # noqa: F401
     distributed_value_and_grad,
 )
 from .sync_batch_norm import SyncBatchNorm, SyncBatchNormalization  # noqa: F401
+from ... import elastic  # noqa: F401  (hvd.elastic.run / hvd.elastic.JaxState)

@@ -23,6 +23,14 @@ def init(store: Optional[Store] = None,
 
     Reference: ``hvd.init()`` → ``horovod_init`` (``operations.cc:752``)."""
     global_state().initialize(store=store, topology=topology)
+    from ...common import env as env_mod
+
+    if env_mod.get_bool(env_mod.HOROVOD_ELASTIC):
+        # Register the notification endpoint as early as possible so the
+        # driver can reach us from the first discovery tick.
+        from ...elastic.state import notification_manager
+
+        notification_manager.start()
 
 
 def shutdown() -> None:

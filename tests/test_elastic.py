@@ -1,0 +1,222 @@
+"""Elastic subsystem tests.
+
+Unit tier mirrors reference `test/single/test_elastic_driver.py` (mock
+discovery, in-process); the integration tier mirrors
+`test/integration/elastic_common.py`: a real `hvdrun --host-discovery-script`
+job against a mutable hosts file, asserting recovery invariants from worker
+logs."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic.discovery import (
+    FixedHosts,
+    HostDiscoveryScript,
+    HostManager,
+)
+from horovod_tpu.elastic.registration import WorkerStateRegistry
+from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.runner.hosts import HostInfo
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _MutableDiscovery:
+    def __init__(self, hosts):
+        self.hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self):
+        return dict(self.hosts)
+
+
+class TestHostManager:
+    def test_stable_order_on_growth(self):
+        disc = _MutableDiscovery({"a": 2})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        disc.hosts["b"] = 2
+        changed, removal = mgr.update_available_hosts()
+        assert changed and not removal
+        assert [h.hostname for h in mgr.current_hosts] == ["a", "b"]
+
+    def test_removal_flag_and_order(self):
+        disc = _MutableDiscovery({"a": 1, "b": 1, "c": 1})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        del disc.hosts["b"]
+        changed, removal = mgr.update_available_hosts()
+        assert changed and removal
+        assert [h.hostname for h in mgr.current_hosts] == ["a", "c"]
+
+    def test_blacklist_excludes_host(self):
+        disc = _MutableDiscovery({"a": 1, "b": 1})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        mgr.blacklist("b")
+        changed, removal = mgr.update_available_hosts()
+        assert changed and removal
+        assert [h.hostname for h in mgr.current_hosts] == ["a"]
+        # blacklisted host reappearing in discovery stays excluded
+        changed, _ = mgr.update_available_hosts()
+        assert not changed
+
+    def test_discovery_script(self, tmp_path):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho hostA:2\necho hostB\n")
+        script.chmod(0o755)
+        disc = HostDiscoveryScript(str(script))
+        assert disc.find_available_hosts_and_slots() == {"hostA": 2, "hostB": 1}
+
+
+def test_worker_state_registry_barrier():
+    reg = WorkerStateRegistry(2)
+    reg.record_success(0)
+    assert not reg.all_accounted()
+    reg.record_failure(1)
+    assert reg.all_accounted()
+    assert reg.failed_ranks() == {1}
+    reg.reset(1)
+    assert not reg.all_accounted()
+
+
+def test_object_state_commit_restore():
+    state = ObjectState(epoch=0, items=[1, 2])
+    state.epoch = 5
+    state.items.append(3)
+    state.restore()
+    assert state.epoch == 0 and state.items == [1, 2]
+    state.epoch = 7
+    state.save()
+    state.epoch = 9
+    state.restore()
+    assert state.epoch == 7
+
+
+_ELASTIC_TRAIN = """
+import os, sys, time
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0)
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 90:
+        v = np.ones(4, np.float32)
+        out = hvd.allreduce(v, op=hvd.Sum, name="grad")
+        assert np.allclose(np.asarray(out), hvd.size()), out
+        print(f"BATCH {state.batch} rank={hvd.rank()} size={hvd.size()}",
+              flush=True)
+        state.batch += 1
+        state.commit()
+        time.sleep(0.15)
+
+train(state)
+print("ELASTIC_DONE", hvd.rank(), flush=True)
+hvd.shutdown()
+"""
+
+
+@pytest.mark.parametrize("mode", ["remove_host"])
+def test_elastic_host_removal_end_to_end(tmp_path, mode):
+    """Two single-slot 'hosts' (localhost + 127.0.0.1); mid-run the hosts
+    file drops one — the survivor re-rendezvouses at size 1 and finishes
+    (reference `test_hosts_added_and_removed` analog)."""
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:1\n127.0.0.1:1\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disc.chmod(0o755)
+    train = tmp_path / "train.py"
+    train.write_text(_ELASTIC_TRAIN)
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        time.sleep(4)  # let a few size-2 batches run
+        hosts_file.write_text("localhost:1\n")  # drop the second host
+        out, err = proc.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        raise AssertionError(f"elastic job hung\nstdout:\n{out}\nstderr:\n{err}")
+    assert proc.returncode == 0, (out, err)
+    assert "ELASTIC_DONE" in out, (out, err)
+    assert "size=2" in out, "never ran at full size"
+    assert "size=1" in out, "never recovered at reduced size"
+
+
+_FAILING_TRAIN = """
+import os, time
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0)
+marker = os.environ["FAIL_MARKER"]
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 60:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="g")
+        print(f"BATCH {state.batch} rank={hvd.rank()} size={hvd.size()}",
+              flush=True)
+        if state.batch == 8 and hvd.rank() == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            os.kill(os.getpid(), 9)  # simulate sudden worker death
+        state.batch += 1
+        state.commit()
+        time.sleep(0.1)
+
+train(state)
+print("ELASTIC_DONE", hvd.rank(), flush=True)
+hvd.shutdown()
+"""
+
+
+def test_elastic_single_rank_failure(tmp_path):
+    """Rank 1 SIGKILLs itself mid-run: its host is blacklisted, the
+    survivor rolls back to the last commit and finishes at size 1
+    (reference `test_single_rank_failure`)."""
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+    disc.chmod(0o755)
+    train = tmp_path / "train.py"
+    train.write_text(_FAILING_TRAIN)
+
+    env = os.environ.copy()
+    env["FAIL_MARKER"] = str(tmp_path / "failed.marker")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        capture_output=True, timeout=180)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "ELASTIC_DONE" in proc.stdout
+    assert "size=2" in proc.stdout and "size=1" in proc.stdout
+    # survivor re-ran from its last committed batch, not from zero
+    assert proc.stdout.count("BATCH 0 ") <= 2, proc.stdout[-1500:]
