@@ -11,58 +11,85 @@ so identical directions average and orthogonal directions add — a
 reduction that adapts to gradient correlation instead of assuming
 independence (Microsoft's Adasum paper).  Dot products and norms accumulate
 in fp64 exactly like the reference's ``double`` accumulators
-(``adasum.h:101-140``).
+(``adasum.h:101-140``); a coefficient falls back to 1.0 when its norm is
+~zero (``adasum.h:385-391``), so a zero gradient contributes nothing and
+the peer's gradient passes through unchanged.
 
 Schedule (VHDD, power-of-two ranks like the reference): at distance d =
 1, 2, 4, ..., each rank pairs with ``rank ^ d``, exchanges the half of the
 buffer the peer owns, combines its kept half with Adasum, recursing on a
 half-sized vector each round; then the halves are allgathered back by
-walking the distances in reverse.  Per-tensor dot/norm triplets are
-reduced per *tensor* (not per fused buffer) so fusion does not change the
-math — same property the reference maintains by carrying per-layer
-state.
+walking the distances in reverse.
+
+Because each rank holds only a *slice* of the logical (a, b) pair at every
+level, the per-tensor (dot, ||a||², ||b||²) triplets computed on the local
+slice are partial sums; they are allreduced across the 2·d ranks that
+together hold the full pair (the "reduction communicator" of
+``adasum.h:368`` ``SumAllreduceWithComm``) before coefficients are formed —
+so every slice of a tensor is combined with the same full-tensor
+coefficients and fusion/slicing does not change the math.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import copy
+from typing import List, Tuple
 
 import numpy as np
 
 from ..common.exceptions import HorovodInternalError
-from ..common.topology import ProcessTopology
-from ..core.messages import Response
+from ..common.logging_util import get_logger
+from ..core.messages import Response, ResponseType
 from ..core.tensor_queue import Status, TensorTableEntry
-from ..transport.tcp import TcpMesh
 from . import cpu_ring
 
+log = get_logger("horovod_tpu.backend.adasum")
 
-def _adasum_combine(a: np.ndarray, b: np.ndarray,
-                    bounds: List[Tuple[int, int]]) -> np.ndarray:
-    """Combine two equal-length fused segments tensor-by-tensor."""
-    out = np.empty_like(a)
-    for lo, hi in bounds:
-        av, bv = a[lo:hi], b[lo:hi]
-        dot = float(np.dot(av.astype(np.float64), bv.astype(np.float64)))
-        na2 = float(np.dot(av.astype(np.float64), av.astype(np.float64)))
-        nb2 = float(np.dot(bv.astype(np.float64), bv.astype(np.float64)))
-        ca = 1.0 - dot / (2.0 * na2) if na2 > 0 else 0.5
-        cb = 1.0 - dot / (2.0 * nb2) if nb2 > 0 else 0.5
-        out[lo:hi] = ca * av + cb * bv
-    return out
+# Below this, a squared norm is treated as zero and the coefficient is 1.0
+# (reference adasum.h:385-391 uses sqrt(DBL_MIN)).
+_NORMSQ_EPS = float(np.sqrt(np.finfo(np.float64).tiny))
 
 
-def _segment_bounds(sizes: List[int], lo: int, hi: int) -> List[Tuple[int, int]]:
-    """Tensor boundaries clipped to the [lo, hi) slice of the fused buffer,
-    re-based to slice-local offsets."""
+def _segment_bounds(sizes: List[int], lo: int,
+                    hi: int) -> List[Tuple[int, int, int]]:
+    """(tensor_index, slice_lo, slice_hi) for every tensor overlapping the
+    [lo, hi) window of the fused buffer, re-based to window-local offsets.
+    Pad regions (beyond the last tensor) belong to no segment."""
     bounds = []
     off = 0
-    for n in sizes:
+    for idx, n in enumerate(sizes):
         t_lo, t_hi = max(off, lo), min(off + n, hi)
         if t_lo < t_hi:
-            bounds.append((t_lo - lo, t_hi - lo))
+            bounds.append((idx, t_lo - lo, t_hi - lo))
         off += n
-    return bounds or [(0, hi - lo)]
+    return bounds
+
+
+def _partial_triplets(a: np.ndarray, b: np.ndarray,
+                      segs: List[Tuple[int, int, int]],
+                      num_tensors: int) -> np.ndarray:
+    """Slice-local (dot, ||a||², ||b||²) partial sums per tensor, fp64."""
+    t = np.zeros((num_tensors, 3), np.float64)
+    for idx, lo, hi in segs:
+        av = a[lo:hi].astype(np.float64, copy=False)
+        bv = b[lo:hi].astype(np.float64, copy=False)
+        t[idx, 0] += float(av @ bv)
+        t[idx, 1] += float(av @ av)
+        t[idx, 2] += float(bv @ bv)
+    return t
+
+
+def _apply_combine(a: np.ndarray, b: np.ndarray,
+                   segs: List[Tuple[int, int, int]],
+                   triplets: np.ndarray) -> np.ndarray:
+    """out = ca·a + cb·b per tensor segment, with full-tensor coefficients."""
+    out = np.zeros_like(a)
+    for idx, lo, hi in segs:
+        dot, na2, nb2 = triplets[idx]
+        ca = 1.0 - dot / (2.0 * na2) if na2 >= _NORMSQ_EPS else 1.0
+        cb = 1.0 - dot / (2.0 * nb2) if nb2 >= _NORMSQ_EPS else 1.0
+        out[lo:hi] = ca * a[lo:hi] + cb * b[lo:hi]
+    return out
 
 
 class AdasumAllreduce(cpu_ring.CollectiveOp):
@@ -71,16 +98,38 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
     def enabled(self, response: Response,
                 entries: List[TensorTableEntry]) -> bool:
         # VHDD needs a power-of-two world (reference adasum.h restriction);
-        # other sizes fall through to the ring-allreduce op registered
+        # other sizes fall through to the averaging ring fallback registered
         # behind this one in the ADASUM chain.
         return (self.topo.size & (self.topo.size - 1)) == 0
+
+    def _allreduce_triplets(self, triplets: np.ndarray,
+                            distance: int) -> np.ndarray:
+        """Sum the per-tensor triplets across the 2·distance ranks that hold
+        slices of the current (a, b) pair — recursive doubling over XOR
+        distances 1..distance (reference SumAllreduceWithComm on the level's
+        reduction communicator, adasum.h:368)."""
+        rank = self.topo.rank
+        j = 1
+        while j <= distance:
+            peer = rank ^ j
+            got = np.frombuffer(
+                self.mesh.sendrecv(peer, triplets.tobytes(), peer),
+                dtype=np.float64).reshape(triplets.shape)
+            triplets = triplets + got
+            j <<= 1
+        return triplets
 
     def execute(self, response: Response,
                 entries: List[TensorTableEntry]) -> Status:
         size, rank = self.topo.size, self.topo.rank
         if size == 1:
             for e in entries:
-                e.output = np.array(e.tensor, copy=True)
+                out = np.array(e.tensor, copy=True)
+                if response.prescale_factor != 1.0:
+                    out = out * response.prescale_factor
+                if response.postscale_factor != 1.0:
+                    out = out * response.postscale_factor
+                e.output = out
             return Status.OK()
         if size & (size - 1):
             raise HorovodInternalError(
@@ -89,12 +138,15 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
 
         acc_dtype = cpu_ring._accum_dtype(entries[0].tensor.dtype)
         buf = cpu_ring.fuse_entries(entries, acc_dtype)
+        if response.prescale_factor != 1.0:
+            buf *= response.prescale_factor
         sizes = [int(np.prod(e.tensor.shape)) if e.tensor.shape else 1
                  for e in entries]
+        num_tensors = len(sizes)
         real_n = buf.size
         # Zero-pad to a multiple of the world size so every halving round
-        # splits evenly; pad regions sit outside all tensor bounds, stay
-        # zero through combines, and are dropped before unfuse.
+        # splits evenly; pad regions sit outside all tensor bounds, are
+        # never touched by a combine, and are dropped before unfuse.
         if real_n % size:
             pad = size - real_n % size
             buf = np.concatenate([buf, np.zeros(pad, acc_dtype)])
@@ -121,12 +173,18 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
                 raise HorovodInternalError(
                     "Adasum exchange size mismatch "
                     f"({peer_half.size} vs {kept.size})")
-            bounds = _segment_bounds(sizes, keep_lo, keep_hi)
-            if rank < peer:
-                combined = _adasum_combine(kept, peer_half, bounds)
+            # Canonical orientation: `a` is the vector accumulated by the
+            # lower subgroup (bit `distance` clear), `b` by the upper —
+            # every rank in the reduction group agrees on which is which.
+            if (rank & distance) == 0:
+                a_slice, b_slice = kept, peer_half
             else:
-                combined = _adasum_combine(peer_half, kept, bounds)
-            buf[keep_lo:keep_hi] = combined
+                a_slice, b_slice = peer_half, kept
+            segs = _segment_bounds(sizes, keep_lo, keep_hi)
+            triplets = _partial_triplets(a_slice, b_slice, segs, num_tensors)
+            triplets = self._allreduce_triplets(triplets, distance)
+            buf[keep_lo:keep_hi] = _apply_combine(
+                a_slice, b_slice, segs, triplets)
             halves.append((distance, keep_upper))
             lo, hi = keep_lo, keep_hi
             distance <<= 1
@@ -152,3 +210,32 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
         cpu_ring.unfuse_entries(
             buf.astype(response.tensor_type.to_numpy(), copy=False), entries)
         return Status.OK()
+
+
+class AdasumRingFallback(cpu_ring.RingAllreduce):
+    """Non-power-of-two ADASUM fallback: ring-sum then average.
+
+    The reference refuses non-pow2 worlds outright; a plain-sum fallback
+    would make ``hvd.Adasum`` of identical gradients return ``size·g`` on 3
+    ranks but ``~g`` on 2/4 ranks — a silent size-dependent magnitude
+    cliff.  Averaging matches Adasum's identical-gradient (fully
+    correlated) behavior, the common case for data-parallel gradients; a
+    loud one-time warning flags the approximation."""
+
+    _warned = False
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        return response.response_type == ResponseType.ADASUM
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        if not AdasumRingFallback._warned:
+            AdasumRingFallback._warned = True
+            log.warning(
+                "Adasum VHDD requires a power-of-two world size (have %d); "
+                "falling back to ring-allreduce AVERAGE, which approximates "
+                "Adasum only for well-correlated gradients", self.topo.size)
+        scaled = copy.copy(response)
+        scaled.postscale_factor = response.postscale_factor / self.topo.size
+        return super().execute(scaled, entries)

@@ -80,10 +80,7 @@ def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry]) -> None:
 
 class RingAllreduce(CollectiveOp):
     def enabled(self, response, entries) -> bool:
-        # Also serves as the ADASUM fallback for non-power-of-two worlds
-        # (plain sum; the reference simply refuses such sizes).
-        return response.response_type in (ResponseType.ALLREDUCE,
-                                          ResponseType.ADASUM)
+        return response.response_type == ResponseType.ALLREDUCE
 
     def execute(self, response: Response,
                 entries: List[TensorTableEntry]) -> Status:

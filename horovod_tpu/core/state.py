@@ -158,14 +158,15 @@ class HorovodGlobalState:
             ResponseType.BROADCAST, cpu_ring.StarBroadcast(topo, mesh))
         self.op_manager.register(
             ResponseType.ALLTOALL, cpu_ring.PairwiseAlltoall(topo, mesh))
-        from ..backend.adasum import AdasumAllreduce
+        from ..backend.adasum import AdasumAllreduce, AdasumRingFallback
 
         self.op_manager.register(
             ResponseType.ADASUM, AdasumAllreduce(topo, mesh))
-        # Non-power-of-two worlds fall back to ring allreduce (the reference
-        # simply rejects them; a fallback keeps hvd.Adasum usable anywhere).
+        # Non-power-of-two worlds fall back to an averaging ring allreduce
+        # (the reference simply rejects them; averaging approximates
+        # Adasum's identical-gradient behavior and keeps hvd.Adasum usable).
         self.op_manager.register(
-            ResponseType.ADASUM, cpu_ring.RingAllreduce(topo, mesh))
+            ResponseType.ADASUM, AdasumRingFallback(topo, mesh))
 
     # ------------------------------------------------------------------
     # background loop

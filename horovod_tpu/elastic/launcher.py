@@ -101,9 +101,8 @@ def launch_elastic_job(args, command: List[str]) -> int:
                     driver.hosts.total_slots() < min_np:
                 log.error("all capacity lost (%d failures)", failures)
                 return 1
-            if driver.reset_limit is not None and \
-                    driver.resets > driver.reset_limit:
-                log.error("elastic reset limit exceeded")
+            if driver.stopped_error:
+                log.error("elastic driver stopped: %s", driver.stopped_error)
                 return 1
     finally:
         driver.stop()

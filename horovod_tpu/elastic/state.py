@@ -26,24 +26,42 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
+_host_update_lock = threading.Lock()
 _host_update_event = threading.Event()
 _host_update_skip_sync = [True]
+_host_update_epoch = [-1.0]  # highest epoch seen; inf for epoch-less pings
 
 
-def notify_hosts_updated(added_only: bool = False) -> None:
+def notify_hosts_updated(added_only: bool = False,
+                         epoch: Optional[int] = None) -> None:
     """Called by the worker notification service when the driver reports a
-    host-set change; surfaces at the next ``commit()``/``check`` point."""
-    _host_update_skip_sync[0] = _host_update_skip_sync[0] and added_only
-    _host_update_event.set()
+    host-set change; surfaces at the next ``commit()``/``check`` point.
+
+    ``epoch`` is the driver's epoch at ping time.  Staleness is judged at
+    CONSUME time (a ping can arrive before the worker re-rendezvouses into
+    the very epoch it advertises — acting on it afterwards would strand the
+    worker waiting for an epoch that never comes, the round-1 failure)."""
+    with _host_update_lock:
+        _host_update_skip_sync[0] = _host_update_skip_sync[0] and added_only
+        _host_update_epoch[0] = max(
+            _host_update_epoch[0], float("inf") if epoch is None else epoch)
+        _host_update_event.set()
 
 
 def _consume_host_update() -> Optional[bool]:
-    if _host_update_event.is_set():
+    from ..common import env as env_mod
+
+    with _host_update_lock:
+        if not _host_update_event.is_set():
+            return None
         _host_update_event.clear()
         skip = _host_update_skip_sync[0]
         _host_update_skip_sync[0] = True
-        return skip
-    return None
+        epoch = _host_update_epoch[0]
+        _host_update_epoch[0] = -1.0
+    if epoch <= env_mod.get_int("HOROVOD_EPOCH", 0):
+        return None  # stale: we already adopted this (or a newer) epoch
+    return skip
 
 
 class State:
@@ -171,20 +189,57 @@ def _reset_and_reinit() -> None:
     core_state.global_state().initialize(topology=topo)
 
 
+def _teardown() -> None:
+    """Best-effort runtime teardown; never raises (used between retries)."""
+    try:
+        from ..frameworks.jax import basics
+
+        basics._internal_reset()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def run(func: Callable) -> Callable:
     """Decorator: retry ``func(state, ...)`` across membership changes
-    (reference ``run_fn``, ``common/elastic.py:147-168``)."""
+    (reference ``run_fn``, ``common/elastic.py:147-168``).
+
+    Re-initialization failures (rendezvous timeout, mesh rebuild races
+    against a concurrent epoch bump) RETRY instead of killing the worker;
+    after ``WORKER_REINIT_ATTEMPTS`` consecutive failures the worker exits
+    with ``TRANSIENT_EXIT_CODE`` so the driver respawns a fresh process
+    rather than blacklisting the host."""
 
     def wrapper(state: State, *args, **kwargs):
-        from ..core.state import global_state
+        import sys
 
+        from ..common.logging_util import get_logger
+        from ..core.state import global_state
+        from .constants import TRANSIENT_EXIT_CODE, WORKER_REINIT_ATTEMPTS
+
+        log = get_logger("horovod_tpu.elastic.run")
         notification_manager.start()
         reset_limit = notification_manager.reset_limit
         resets = 0
         skip_sync = False
+        reinit_failures = 0
         while True:
             if not global_state().initialized.is_set():
-                _reset_and_reinit()
+                try:
+                    _reset_and_reinit()
+                except (SystemExit, KeyboardInterrupt):
+                    raise  # removed from the job / user interrupt
+                except BaseException as e:  # noqa: BLE001
+                    reinit_failures += 1
+                    log.warning("elastic re-init failed (%d/%d): %s",
+                                reinit_failures, WORKER_REINIT_ATTEMPTS, e)
+                    if reinit_failures >= WORKER_REINIT_ATTEMPTS:
+                        log.error("giving up after %d re-init failures; "
+                                  "exiting for a driver respawn",
+                                  reinit_failures)
+                        sys.exit(TRANSIENT_EXIT_CODE)
+                    _teardown()
+                    continue
+                reinit_failures = 0
             try:
                 if not skip_sync:
                     state.sync()
@@ -199,7 +254,7 @@ def run(func: Callable) -> Callable:
                 raise RuntimeError(
                     f"Exceeded elastic reset limit ({reset_limit})")
             state.on_reset()
-            _reset_and_reinit()
+            _teardown()
 
     return wrapper
 

@@ -30,10 +30,15 @@ class _NotifyHandler(BaseHTTPRequestHandler):
         pass
 
     def do_POST(self):
+        from urllib.parse import parse_qs, urlparse
+
         from .state import notify_hosts_updated
 
-        added_only = self.path.rstrip("/").endswith("added")
-        notify_hosts_updated(added_only=added_only)
+        parsed = urlparse(self.path)
+        added_only = parsed.path.rstrip("/").endswith("added")
+        epoch_vals = parse_qs(parsed.query).get("epoch")
+        epoch = int(epoch_vals[0]) if epoch_vals else None
+        notify_hosts_updated(added_only=added_only, epoch=epoch)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -69,12 +74,15 @@ class WorkerNotificationClient:
     def __init__(self, addresses: List[str]):
         self._addresses = addresses
 
-    def notify_hosts_updated(self, added_only: bool) -> None:
+    def notify_hosts_updated(self, added_only: bool,
+                             epoch: Optional[int] = None) -> None:
         suffix = "added" if added_only else "changed"
+        query = f"?epoch={epoch}" if epoch is not None else ""
         for addr in self._addresses:
             try:
                 req = urllib.request.Request(
-                    f"http://{addr}/notify/{suffix}", data=b"", method="POST")
+                    f"http://{addr}/notify/{suffix}{query}",
+                    data=b"", method="POST")
                 with urllib.request.urlopen(req, timeout=5):
                     pass
             except OSError as e:

@@ -131,8 +131,11 @@ print("CACHE_OK", rank, flush=True)
 
 
 def test_adasum_two_rank_matches_formula():
-    """VHDD with 2 ranks: each half combined with the closed-form Adasum
-    operator (reference adasum.h:194-450 semantics)."""
+    """VHDD with 2 ranks matches the closed-form Adasum operator computed
+    on the FULL vectors: the per-level (dot, ||a||², ||b||²) triplets are
+    allreduced across the reduction group before coefficients are formed
+    (reference adasum.h:368 SumAllreduceWithComm), so slicing does not
+    change the math."""
     out = run_distributed(2, """
 a = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
 b = np.array([2.0, 2.0, -1.0, 0.5], np.float32)
@@ -141,16 +144,55 @@ result = np.asarray(hvd.allreduce(mine, op=hvd.Adasum, name="adasum.t"))
 
 def combine(x, y):
     dot = float(np.dot(x, y)); nx = float(np.dot(x, x)); ny = float(np.dot(y, y))
-    cx = 1 - dot / (2 * nx) if nx > 0 else 0.5
-    cy = 1 - dot / (2 * ny) if ny > 0 else 0.5
+    cx = 1 - dot / (2 * nx) if nx > 0 else 1.0
+    cy = 1 - dot / (2 * ny) if ny > 0 else 1.0
     return cx * x + cy * y
 
-expected = np.concatenate([combine(a[:2], b[:2]), combine(a[2:], b[2:])])
+expected = combine(a, b)
 assert np.allclose(result, expected, atol=1e-5), (result, expected)
 print("ADASUM_OK", rank, flush=True)
 """)
     for r, o in enumerate(out):
         assert f"ADASUM_OK {r}" in o
+
+
+def test_adasum_four_rank_matches_formula():
+    """4-rank VHDD: pairwise tree of full-vector combines — (r0⊕r1) ⊕
+    (r2⊕r3) with global coefficients at both levels."""
+    out = run_distributed(4, """
+vecs = [np.array([1.0, 2.0, 3.0, 4.0], np.float32),
+        np.array([2.0, 2.0, -1.0, 0.5], np.float32),
+        np.array([-1.0, 0.5, 2.0, 1.0], np.float32),
+        np.array([0.5, -2.0, 1.0, 3.0], np.float32)]
+result = np.asarray(hvd.allreduce(vecs[rank], op=hvd.Adasum, name="adasum.q"))
+
+def combine(x, y):
+    x = x.astype(np.float64); y = y.astype(np.float64)
+    dot = float(x @ y); nx = float(x @ x); ny = float(y @ y)
+    cx = 1 - dot / (2 * nx) if nx > 0 else 1.0
+    cy = 1 - dot / (2 * ny) if ny > 0 else 1.0
+    return cx * x + cy * y
+
+expected = combine(combine(vecs[0], vecs[1]), combine(vecs[2], vecs[3]))
+assert np.allclose(result, expected, atol=1e-4), (result, expected)
+print("ADASUM4_OK", rank, flush=True)
+""")
+    for r, o in enumerate(out):
+        assert f"ADASUM4_OK {r}" in o
+
+
+def test_adasum_zero_gradient_passthrough():
+    """A zero gradient has coefficient 1.0 on the other side (reference
+    adasum.h:385-391): adasum(0, g) == g, not g/2."""
+    out = run_distributed(2, """
+g = np.array([1.0, -2.0, 3.0], np.float32)
+mine = np.zeros(3, np.float32) if rank == 0 else g
+result = np.asarray(hvd.allreduce(mine, op=hvd.Adasum, name="adasum.z"))
+assert np.allclose(result, g, atol=1e-5), result
+print("ZERO_OK", rank, flush=True)
+""")
+    for r, o in enumerate(out):
+        assert f"ZERO_OK {r}" in o
 
 
 def test_adasum_identical_gradients_average():
@@ -215,7 +257,9 @@ print("ODD_OK", rank, flush=True)
     out = run_distributed(3, """
 v = np.ones(4, np.float32)
 result = np.asarray(hvd.allreduce(v, op=hvd.Adasum, name="adasum.np2"))
-assert np.allclose(result, 3.0), result  # ring-sum fallback
+# averaging ring fallback: identical gradients -> ~g, matching Adasum's
+# identical-gradient behavior instead of a silent size-x sum
+assert np.allclose(result, 1.0), result
 print("NP2_OK", rank, flush=True)
 """)
     for r, o in enumerate(out):
