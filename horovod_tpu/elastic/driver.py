@@ -89,6 +89,11 @@ class ElasticDriver:
         self._await_ack: Optional[bool] = None  # added_only flavor, or None
         self._removed_identities: set = set()
         self._exited_identities: set = set()
+        # Once any worker succeeds the job is winding down: membership no
+        # longer changes, so a finished (dead-but-successful) identity can
+        # never be handed a rank in a fresh epoch (reference
+        # registration.py:139-143 stops the driver on first SUCCESS).
+        self._success = False
 
     # ------------------------------------------------------------------
 
@@ -224,6 +229,12 @@ class ElasticDriver:
             # Identities that should have a process but whose worker died
             # (without the host being blacklisted) need a respawn epoch.
             with self._lock:
+                if self._success:
+                    # Winding down: never rendezvous a new epoch once a
+                    # worker finished — a fresh slot table would assign a
+                    # rank to the dead-but-successful identity and hang the
+                    # survivors' mesh build.
+                    continue
                 missing_workers = {
                     f"{s.hostname}:{s.local_rank}" for s in self._slots
                 } - set(self._known_identities)
@@ -256,12 +267,14 @@ class ElasticDriver:
         tick (pinging acked workers too would feed them stale interrupts)."""
         if self._await_ack is None or self.epoch == 0:
             return
-        identities = {f"{s.hostname}:{s.local_rank}" for s in self._slots}
-        # Removed identities need the ping too (it is what makes their
-        # worker see rank −1 and exit promptly); they ack before exiting.
-        # Identities whose process already exited have nobody listening.
-        identities.update(self._removed_identities)
-        identities -= self._exited_identities
+        with self._lock:
+            identities = {f"{s.hostname}:{s.local_rank}" for s in self._slots}
+            # Removed identities need the ping too (it is what makes their
+            # worker see rank −1 and exit promptly); they ack before
+            # exiting.  Identities whose process exited have nobody
+            # listening.
+            identities.update(self._removed_identities)
+            identities -= self._exited_identities
         unacked = set()
         for identity in identities:
             raw = self.rendezvous.get("epoch_ack", identity)
@@ -284,10 +297,11 @@ class ElasticDriver:
         if self._shutdown.is_set():
             return
         identity = f"{slot.hostname}:{slot.local_rank}"
-        self._exited_identities.add(identity)
         if exit_code == 0:
             self._registry.record_success(slot.rank)
             with self._lock:
+                self._exited_identities.add(identity)
+                self._success = True
                 # A clean exit clears the host's record: sporadic transient
                 # strikes spread over a long job must not accumulate into a
                 # blacklist of a healthy host.
@@ -297,6 +311,7 @@ class ElasticDriver:
         self._registry.record_failure(slot.rank)
         transient = exit_code == TRANSIENT_EXIT_CODE
         with self._lock:
+            self._exited_identities.add(identity)
             counters = self._transient_failures if transient \
                 else self._crash_failures
             counters[slot.hostname] += 1

@@ -220,3 +220,109 @@ def test_elastic_single_rank_failure(tmp_path):
     assert "size=2" in proc.stdout and "size=1" in proc.stdout
     # survivor re-ran from its last committed batch, not from zero
     assert proc.stdout.count("BATCH 0 ") <= 2, proc.stdout[-1500:]
+
+
+_ALL_FAIL_TRAIN = """
+import os, time
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0)
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 60:
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="g")
+        if state.batch == 4:
+            os.kill(os.getpid(), 9)  # every rank dies
+        state.batch += 1
+        state.commit()
+        time.sleep(0.1)
+
+train(state)
+hvd.shutdown()
+"""
+
+
+def test_elastic_all_ranks_failure(tmp_path):
+    """Every rank SIGKILLs itself: the job must FAIL promptly and cleanly
+    (reference `test_all_ranks_failure`, elastic_common.py:199) rather than
+    hang waiting for capacity."""
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+    disc.chmod(0o755)
+    train = tmp_path / "train.py"
+    train.write_text(_ALL_FAIL_TRAIN)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, capture_output=True, timeout=120)
+    assert proc.returncode != 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    assert "ELASTIC_DONE" not in proc.stdout
+
+
+_TRANSIENT_TRAIN = """
+import os, sys, time
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+from horovod_tpu.elastic.constants import TRANSIENT_EXIT_CODE
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0)
+marker = os.environ["FAIL_MARKER"]
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 40:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="g")
+        print(f"BATCH {state.batch} rank={hvd.rank()} size={hvd.size()}",
+              flush=True)
+        if state.batch == 6 and hvd.rank() == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(TRANSIENT_EXIT_CODE)  # transient casualty, host healthy
+        state.batch += 1
+        state.commit()
+        time.sleep(0.1)
+
+train(state)
+print("ELASTIC_DONE", hvd.rank(), "size", hvd.size(), flush=True)
+hvd.shutdown()
+"""
+
+
+def test_elastic_transient_exit_respawns_without_blacklist(tmp_path):
+    """A worker exiting with TRANSIENT_EXIT_CODE is respawned on the same
+    host (below the transient blacklist threshold): the job finishes back
+    at FULL size, proving the host was not blacklisted."""
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+    disc.chmod(0o755)
+    train = tmp_path / "train.py"
+    train.write_text(_TRANSIENT_TRAIN)
+
+    env = os.environ.copy()
+    env["FAIL_MARKER"] = str(tmp_path / "t.marker")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env, capture_output=True, timeout=180)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    # both ranks finish, and they finish at size 2 (host came back)
+    assert proc.stdout.count("ELASTIC_DONE") == 2, proc.stdout[-1500:]
+    assert "ELASTIC_DONE 0 size 2" in proc.stdout
