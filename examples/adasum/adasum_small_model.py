@@ -1,0 +1,48 @@
+"""Adasum on a small model (port of reference
+``examples/adasum/adasum_small_model.py``).
+
+Compares convergence of op=Average vs op=Adasum on a toy regression;
+Adasum adapts the merge to gradient correlation instead of assuming
+independence, so larger effective learning rates stay stable.
+
+Run: ``hvdrun -np 2 python examples/adasum/adasum_small_model.py``
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def run(op_name: str, op, lr: float, steps: int) -> float:
+    rng = np.random.RandomState(100 + hvd.rank())
+    w = np.zeros(8, np.float32)
+    true_w = np.arange(8, dtype=np.float32)
+    for step in range(steps):
+        x = rng.randn(16, 8).astype(np.float32)
+        y = x @ true_w
+        grad = -2 * x.T @ (y - x @ w) / len(x)
+        merged = np.asarray(hvd.allreduce(
+            grad, op=op, name=f"{op_name}.{step}"))
+        w = w - lr * merged
+    return float(np.square(w - true_w).mean())
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    hvd.init()
+    err_avg = run("avg", hvd.Average, args.lr, args.steps)
+    err_ada = run("ada", hvd.Adasum, args.lr, args.steps)
+    if hvd.rank() == 0:
+        print(f"final error  average={err_avg:.5f}  adasum={err_ada:.5f}",
+              flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

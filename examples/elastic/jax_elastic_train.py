@@ -1,0 +1,57 @@
+"""Elastic training example (port of reference ``examples/elastic/tensorflow2``
+recipe to the native flavor).
+
+Run with a mutable discovery script — e.g.::
+
+    echo 'echo localhost:2' > discover.sh && chmod +x discover.sh
+    hvdrun -np 2 --min-np 1 --host-discovery-script ./discover.sh \
+        python examples/elastic/jax_elastic_train.py
+
+Workers added/removed mid-run trigger commit/rollback + re-rendezvous; the
+job survives preemption down to ``--min-np`` workers.
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batches", type=int, default=200)
+    parser.add_argument("--commit-every", type=int, default=10)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(1234)
+    w = np.zeros(16, np.float32)  # toy model: linear regression weights
+    state = hvd.elastic.ObjectState(batch=0, w=w)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < args.batches:
+            x = rng.randn(32, 16).astype(np.float32)
+            y = x @ np.arange(16, dtype=np.float32)
+            grad = -2 * x.T @ (y - x @ state.w) / len(x)
+            avg = np.asarray(hvd.allreduce(grad, name=f"grad"))
+            state.w = state.w - 0.01 * avg
+            state.batch += 1
+            if state.batch % args.commit_every == 0:
+                state.commit()  # snapshot + membership-change check
+                if hvd.rank() == 0:
+                    err = float(np.square(
+                        state.w - np.arange(16)).mean())
+                    print(f"batch {state.batch} size={hvd.size()} "
+                          f"err={err:.4f}", flush=True)
+
+    train(state)
+    if hvd.rank() == 0:
+        print("ELASTIC TRAINING DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""jax synthetic ResNet-50 benchmark — the native flavor.
+
+Two modes:
+- ``--mode eager``: the Horovod-style eager path (``hvd.allreduce`` of
+  grads via ``DistributedOptimizer``) — any-tensor-any-time semantics, XLA
+  data plane when launched with ``hvdrun --data-plane xla``.
+- ``--mode spmd`` (default): the TPU-first path — one jit'd train step over
+  the device mesh, gradient sync folded into the step as a psum (XLA fuses
+  it with backprop; this is the configuration ``bench.py`` measures).
+
+Run: ``hvdrun -np 2 python examples/jax/jax_synthetic_benchmark.py --mode eager``
+     ``python examples/jax/jax_synthetic_benchmark.py  # single-process spmd``
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", default="spmd", choices=["spmd", "eager"])
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-warmup-batches", type=int, default=2)
+    parser.add_argument("--num-iters", type=int, default=3)
+    parser.add_argument("--num-batches-per-iter", type=int, default=3)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.models.training import create_train_state
+
+    hvd.init()
+
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.ones((args.batch_size, args.image_size, args.image_size, 3),
+                      jnp.bfloat16)
+    labels = jnp.zeros((args.batch_size,), jnp.int32)
+    tx = optax.sgd(0.01 * hvd.size(), momentum=0.9)
+
+    if args.mode == "spmd":
+        from horovod_tpu.models.training import make_sharded_train_step
+        from horovod_tpu.parallel import MeshSpec, build_mesh, shard_batch
+
+        mesh = build_mesh(MeshSpec(data=-1))
+        state = create_train_state(model, rng, images, tx, mesh=mesh,
+                                   init_kwargs={"train": True})
+        step = make_sharded_train_step(model, tx, mesh,
+                                       has_batch_stats=True, donate=True)
+        batch = shard_batch(mesh, {"x": images, "y": labels})
+
+        def benchmark_step():
+            nonlocal state
+            state, loss = step(state, batch)
+            return loss
+    else:
+        from horovod_tpu.frameworks.jax.optimizer import DistributedOptimizer
+
+        state = create_train_state(model, rng, images, tx,
+                                   init_kwargs={"train": True})
+        dopt = DistributedOptimizer(tx)
+        opt_state = dopt.init(state.params)
+
+        @jax.jit
+        def grad_step(params, batch_stats):
+            def loss_fn(p):
+                out, updates = model.apply(
+                    {"params": p, "batch_stats": batch_stats}, images,
+                    train=True, mutable=["batch_stats"])
+                one_hot = jax.nn.one_hot(labels, 1000)
+                return optax.softmax_cross_entropy(out, one_hot).mean(), updates
+            (loss, updates), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, grads, updates["batch_stats"]
+
+        params = state.params
+        batch_stats = state.batch_stats
+
+        def benchmark_step():
+            nonlocal params, batch_stats, opt_state
+            loss, grads, batch_stats = grad_step(params, batch_stats)
+            # eager allreduce of the grad pytree (the Horovod path)
+            updates, opt_state = dopt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return loss
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    log(f"mode={args.mode} batch={args.batch_size} ranks={hvd.size()} "
+        f"devices={len(jax.local_devices())}")
+    for _ in range(args.num_warmup_batches):
+        jax.block_until_ready(benchmark_step())
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            loss = benchmark_step()
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter / dt
+        log(f"Iter #{i}: {img_sec:.1f} img/sec per rank")
+        img_secs.append(img_sec)
+
+    mean = float(np.mean(img_secs))
+    total = np.asarray(hvd.allreduce(np.array([mean]), op=hvd.Sum,
+                                     name="imgsec"))[0]
+    log(f"Img/sec per rank: {mean:.1f}")
+    log(f"Total img/sec on {hvd.size()} rank(s): {total:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""PyTorch synthetic ResNet-50 benchmark (port of reference
+``examples/pytorch/pytorch_synthetic_benchmark.py``).
+
+Run: ``hvdrun -np 2 python examples/pytorch/pytorch_synthetic_benchmark.py --num-iters 3``
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-warmup-batches", type=int, default=2)
+    parser.add_argument("--num-batches-per-iter", type=int, default=3)
+    parser.add_argument("--num-iters", type=int, default=3)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    parser.add_argument("--image-size", type=int, default=224)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    import torch
+    import torch.nn.functional as F
+
+    torch.manual_seed(1234 + hvd.rank())
+    try:
+        import torchvision.models as models
+
+        model = getattr(models, args.model)()
+    except ImportError:
+        # torchvision-free fallback: a small conv net with the same
+        # benchmark structure (the reference hard-requires torchvision).
+        model = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 32, 3, stride=2), torch.nn.ReLU(),
+            torch.nn.Conv2d(32, 64, 3, stride=2), torch.nn.ReLU(),
+            torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+            torch.nn.Linear(64, 1000))
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size())
+
+    compression = hvd.Compression.fp16 if args.fp16_allreduce \
+        else hvd.Compression.none
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        output = model(data)
+        loss = F.cross_entropy(output, target)
+        loss.backward()
+        optimizer.step()
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    log(f"Model: {args.model}, batch size {args.batch_size}, "
+        f"ranks {hvd.size()}")
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t = timeit.timeit(benchmark_step, number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        log(f"Iter #{i}: {img_sec:.1f} img/sec per rank")
+        img_secs.append(img_sec)
+
+    img_sec_mean = np.mean(img_secs)
+    total = hvd.allreduce(
+        np.array([img_sec_mean], np.float64), op=hvd.Sum,
+        name="imgsec").numpy()[0]
+    log(f"Img/sec per rank: {img_sec_mean:.1f}")
+    log(f"Total img/sec on {hvd.size()} rank(s): {total:.1f}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""TF2 synthetic ResNet-50 benchmark (port of reference
+``examples/tensorflow2/tensorflow2_synthetic_benchmark.py``).
+
+Measures images/sec with synthetic data — warmup batches, then timed
+batches, allreduce-averaged across ranks.
+
+Run: ``hvdrun -np 2 python examples/tensorflow2/tensorflow2_synthetic_benchmark.py --num-iters 3``
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="ResNet50")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-warmup-batches", type=int, default=2)
+    parser.add_argument("--num-batches-per-iter", type=int, default=3)
+    parser.add_argument("--num-iters", type=int, default=3)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    parser.add_argument("--image-size", type=int, default=224)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    import tensorflow as tf
+
+    model = getattr(tf.keras.applications, args.model)(
+        weights=None,
+        input_shape=(args.image_size, args.image_size, 3))
+    opt = tf.keras.optimizers.SGD(0.01 * hvd.size())
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    compression = hvd.Compression.fp16 if args.fp16_allreduce \
+        else hvd.Compression.none
+
+    data = tf.random.uniform(
+        [args.batch_size, args.image_size, args.image_size, 3])
+    target = tf.random.uniform([args.batch_size], minval=0, maxval=999,
+                               dtype=tf.int64)
+
+    def benchmark_step(first_batch: bool):
+        with hvd.DistributedGradientTape(
+                tf.GradientTape(), compression=compression) as tape:
+            probs = model(data, training=True)
+            loss = loss_fn(target, probs)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first_batch:
+            # Broadcast initial state from rank 0 AFTER the first step so
+            # optimizer slots exist (reference comment, tf2 benchmark).
+            hvd.broadcast_variables(model.variables)
+            hvd.broadcast_variables(opt.variables)
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    log(f"Model: {args.model}, batch size {args.batch_size}, "
+        f"ranks {hvd.size()}")
+    benchmark_step(first_batch=True)
+    for _ in range(args.num_warmup_batches - 1):
+        benchmark_step(first_batch=False)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t = timeit.timeit(lambda: benchmark_step(first_batch=False),
+                          number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        log(f"Iter #{i}: {img_sec:.1f} img/sec per rank")
+        img_secs.append(img_sec)
+
+    img_sec_mean = np.mean(img_secs)
+    total = np.asarray(hvd.allreduce(
+        np.array([img_sec_mean], np.float64), op=hvd.Sum, name="imgsec"))[0]
+    log(f"Img/sec per rank: {img_sec_mean:.1f}")
+    log(f"Total img/sec on {hvd.size()} rank(s): {total:.1f}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

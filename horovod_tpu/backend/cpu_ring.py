@@ -227,10 +227,37 @@ class PairwiseAlltoall(CollectiveOp):
 def zero_entry_for(response: Response, index: int, offset_elems: int,
                    num_elems: int) -> TensorTableEntry:
     """Zero-substitute a tensor a joined rank never submitted (reference
-    ``tensor_queue.h:39-41`` builds zero tensors for joined ranks)."""
+    ``tensor_queue.h:39-41`` builds zero tensors for joined ranks).
+
+    When the response was negotiated on the XLA device plane, the zeros are
+    a jax device array so the joined rank still takes the same (device)
+    code path as its peers — a host-numpy substitute would silently flip
+    this rank to the TCP backend while the others run the XLA collective."""
     dtype = response.tensor_type.to_numpy()
+    from . import xla as xla_backend
+
+    if response.devices == [xla_backend.XLA_DEVICE_ID]:
+        if not xla_backend.context().ready:
+            # Peers negotiated the device plane but this rank cannot join
+            # it: a numpy substitute would silently flip this rank to the
+            # TCP backend while the others dispatch the XLA collective — a
+            # cross-rank deadlock.  Fail loudly instead; the peers' device
+            # collective times out and errors, and elastic recovery (when
+            # enabled) takes over.
+            from ..common.exceptions import HorovodInternalError
+
+            raise HorovodInternalError(
+                "join zero-substitution: peers negotiated the XLA device "
+                "plane but the local XlaContext is not ready")
+        import jax.numpy as jnp
+
+        zeros = jnp.zeros(num_elems, dtype=dtype)
+    else:
+        zeros = np.zeros(num_elems, dtype=dtype)
     return TensorTableEntry(
         tensor_name=response.tensor_names[index],
-        tensor=np.zeros(num_elems, dtype=dtype),
+        tensor=zeros,
         callback=lambda status, entry: None,
+        device=(xla_backend.XLA_DEVICE_ID
+                if not isinstance(zeros, np.ndarray) else -1),
     )

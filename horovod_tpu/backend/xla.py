@@ -1,0 +1,424 @@
+"""XLA/TPU eager data plane — device collectives for the eager runtime.
+
+Role of the reference's NCCL backend (``nccl_operations.cc:126-191``: fuse →
+collective on a private stream → unfuse, completion from a finalizer
+thread), redesigned for XLA's compilation model instead of translated from
+CUDA:
+
+- **No NCCL**: the collective itself is a jit-compiled XLA computation over
+  a global ``jax.sharding.Mesh`` spanning one device per Horovod process
+  (multi-controller jax; ``jax.distributed`` plays the role of
+  ``ncclCommInitRank``).  On TPU pods the reduce rides ICI/DCN; in tests it
+  rides jax's Gloo-backed CPU collectives.
+- **No per-shape recompiles** (SURVEY §7.4's make-or-break problem): fused
+  buffers are padded to power-of-two *buckets*, so the cross-process
+  collective compiles once per (bucket, dtype, op) — the analog of NCCL
+  being shape-oblivious.  The local fuse/unfuse copies compile once per
+  entry-composition (steady-state training has a fixed set of
+  compositions, like the reference's fusion-buffer layouts).
+- **Async completion**: dispatch returns unready device arrays; callbacks
+  fire from the global state's finalizer thread once XLA signals
+  completion (``gpu_operations.h:98-127`` finalizer-thread design), so the
+  background negotiation loop never blocks on device work.
+
+Correctness under multi-controller jax relies on one invariant the
+controller already guarantees: every rank executes the same negotiated
+responses in the same order, so the global jit computations are dispatched
+in identical order on every process (the same invariant NCCL demands of
+its launch order).
+
+Rank agreement on the data plane itself is negotiated, not assumed: the
+``device`` field of each Request (device vs host memory) rides the wire,
+``ConstructResponse`` unions it into ``response.devices``, and the ops here
+enable only when EVERY rank submitted a device tensor — a mixed submission
+falls back to the TCP ring on all ranks consistently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common import env as env_mod
+from ..common.logging_util import get_logger
+from ..common.topology import ProcessTopology
+from ..core.messages import Response, ResponseType
+from ..core.tensor_queue import Status, TensorTableEntry
+
+log = get_logger("horovod_tpu.backend.xla")
+
+# Device id used in Requests for tensors staying in device memory (host
+# memory is -1, matching the reference's CPU_DEVICE_ID convention).
+XLA_DEVICE_ID = 0
+
+_MIN_BUCKET = 1 << 8  # 256 elements — below this, padding dominates
+
+
+def bucket_elems(n: int) -> int:
+    """Power-of-two bucket for an n-element fused payload."""
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+class XlaContext:
+    """Owns the global one-device-per-process mesh for the eager plane.
+
+    Singleton via :func:`context`; built during runtime initialization when
+    ``HOROVOD_DATA_PLANE=xla`` (or a single-process world, where it is
+    always safe).  ``ready`` is False whenever preconditions fail, in which
+    case the op chain simply falls through to the TCP ring backend.
+    """
+
+    def __init__(self):
+        self.ready = False
+        self.mesh = None
+        self.device = None
+        self.topo: Optional[ProcessTopology] = None
+        self._compiled: Dict[Tuple, Callable] = {}
+        self._lock = threading.Lock()
+
+    def initialize(self, topo: ProcessTopology) -> None:
+        self.ready = False
+        self.topo = topo
+        try:
+            import jax
+            from jax.sharding import Mesh
+
+            if topo.size == 1:
+                self.device = jax.local_devices()[0]
+                self.mesh = Mesh(np.array([self.device]), ("proc",))
+                self.ready = True
+                return
+            if not jax.distributed.is_initialized():
+                log.warning(
+                    "XLA data plane requested but jax.distributed is not "
+                    "initialized; falling back to the TCP data plane")
+                return
+            if jax.process_count() != topo.size or \
+                    jax.process_index() != topo.rank:
+                log.warning(
+                    "XLA data plane topology mismatch (jax procs=%d/%d vs "
+                    "horovod %d/%d); falling back to TCP",
+                    jax.process_index(), jax.process_count(),
+                    topo.rank, topo.size)
+                return
+            # One device per process: the eager plane stages each rank's
+            # contribution on its first local device (process-per-chip
+            # launch model makes this THE chip; with more local devices the
+            # rest remain dedicated to the SPMD/jit path).
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[p] for p in sorted(per_proc)]
+            if len(devs) != topo.size:
+                log.warning("XLA data plane: %d jax processes != world %d",
+                            len(devs), topo.size)
+                return
+            self.device = per_proc[topo.rank]
+            self.mesh = Mesh(np.array(devs), ("proc",))
+            self.ready = True
+            log.info("XLA eager data plane up: %d-process mesh on %s",
+                     topo.size, self.device.platform)
+        except Exception as e:  # noqa: BLE001
+            log.warning("XLA data plane unavailable (%s); using TCP", e)
+            self.ready = False
+
+    def reset(self) -> None:
+        self.ready = False
+        self.mesh = None
+        self.device = None
+        self._compiled.clear()
+
+    # -- compile caches -------------------------------------------------
+
+    def _get(self, key: Tuple, build: Callable[[], Callable]) -> Callable:
+        with self._lock:
+            fn = self._compiled.get(key)
+            if fn is None:
+                fn = build()
+                self._compiled[key] = fn
+            return fn
+
+    def fuse(self, entries: List[TensorTableEntry], bucket: int,
+             np_dtype) -> Any:
+        """Local fuse: ravel + concat + pad to ``bucket`` on this rank's
+        mesh device (MemcpyInFusionBuffer analog; compiles once per
+        composition)."""
+        import jax
+        import jax.numpy as jnp
+
+        shapes = tuple(tuple(e.tensor.shape) for e in entries)
+        key = ("fuse", shapes, str(np_dtype), bucket)
+
+        def build():
+            def f(*tensors):
+                flat = [t.ravel() for t in tensors]
+                total = sum(int(np.prod(s)) if s else 1 for s in shapes)
+                if bucket > total:
+                    flat.append(jnp.zeros((bucket - total,), np_dtype))
+                return jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+            return jax.jit(f)
+
+        fused = self._get(key, build)(*[e.tensor for e in entries])
+        return jax.device_put(fused, self.device)
+
+    def unfuse(self, buf: Any, entries: List[TensorTableEntry]) -> None:
+        """Local unfuse: slice the (local, replicated) result buffer back
+        into per-entry outputs (MemcpyOutFusionBuffer analog)."""
+        import jax
+
+        shapes = tuple(tuple(e.tensor.shape) for e in entries)
+        key = ("unfuse", shapes, str(buf.dtype), buf.shape)
+
+        def build():
+            def f(x):
+                outs = []
+                off = 0
+                for s in shapes:
+                    n = int(np.prod(s)) if s else 1
+                    outs.append(x[off:off + n].reshape(s))
+                    off += n
+                return tuple(outs)
+            return jax.jit(f)
+
+        outs = self._get(key, build)(buf)
+        for e, o in zip(entries, outs):
+            e.output = o
+
+    def global_input(self, local_buf: Any) -> Any:
+        """[bucket] local buffer → [P, bucket] global array sharded over the
+        process axis (the staged fusion buffer every process contributes)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        b = local_buf.shape[0]
+        local = local_buf.reshape(1, b)
+        if self.topo.size == 1:
+            return jax.device_put(
+                local, NamedSharding(self.mesh, P("proc")))
+        return jax.make_array_from_single_device_arrays(
+            (self.topo.size, b), NamedSharding(self.mesh, P("proc")),
+            [jax.device_put(local, self.device)])
+
+    def local_view(self, global_out: Any) -> Any:
+        """Replicated global result → this process's single-device array."""
+        return global_out.addressable_data(0)
+
+    # -- bucketed cross-process computations ----------------------------
+
+    def allreduce_fn(self, bucket: int, np_dtype, prescale: float,
+                     postscale: float) -> Callable:
+        """[P, bucket] sharded → [bucket] replicated sum.  ``jnp.sum`` over
+        the sharded axis with a replicated out_sharding lowers to a single
+        XLA AllReduce over the mesh (ICI on TPU)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("allreduce", bucket, str(np_dtype), prescale, postscale)
+
+        def build():
+            in_sh = NamedSharding(self.mesh, P("proc"))
+            rep = NamedSharding(self.mesh, P())
+            dt = np.dtype(np_dtype)
+            widen = dt.itemsize <= 2 and jnp.issubdtype(dt, jnp.floating)
+
+            def f(x):
+                acc = x.astype(jnp.float32) if widen else x
+                if prescale != 1.0:
+                    acc = acc * prescale
+                s = jnp.sum(acc, axis=0)
+                if postscale != 1.0:
+                    s = s * postscale
+                return s.astype(dt)
+
+            return jax.jit(f, in_shardings=(in_sh,), out_shardings=rep)
+
+        return self._get(key, build)
+
+    def allgather_fn(self, bucket: int, np_dtype) -> Callable:
+        """[P, bucket] sharded → [P, bucket] replicated (XLA AllGather)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("allgather", bucket, str(np_dtype))
+
+        def build():
+            in_sh = NamedSharding(self.mesh, P("proc"))
+            rep = NamedSharding(self.mesh, P())
+            return jax.jit(lambda x: x, in_shardings=(in_sh,),
+                           out_shardings=rep)
+
+        return self._get(key, build)
+
+    def broadcast_fn(self, bucket: int, np_dtype, root: int) -> Callable:
+        """[P, bucket] sharded → [bucket] replicated row ``root``
+        (XLA lowers the slice + replicate to a broadcast from root)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("broadcast", bucket, str(np_dtype), root)
+
+        def build():
+            in_sh = NamedSharding(self.mesh, P("proc"))
+            rep = NamedSharding(self.mesh, P())
+            return jax.jit(lambda x: x[root], in_shardings=(in_sh,),
+                           out_shardings=rep)
+
+        return self._get(key, build)
+
+
+_context = XlaContext()
+
+# Dispatch counters, keyed by op name — lets tests (and the timeline)
+# assert that a collective actually took the device path rather than
+# silently falling back to the TCP ring.
+stats: Dict[str, int] = {}
+
+
+def _count(op_name: str) -> None:
+    stats[op_name] = stats.get(op_name, 0) + 1
+
+
+def context() -> XlaContext:
+    return _context
+
+
+def is_jax_array(t: Any) -> bool:
+    try:
+        import jax
+
+        return isinstance(t, jax.Array)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def data_plane_requested() -> str:
+    """'xla' | 'auto' | 'cpu' from HOROVOD_DATA_PLANE.
+
+    'xla' is a hard request (misconfiguration raises at init); 'auto'
+    opportunistically uses the device plane when jax.distributed comes up
+    and silently falls back otherwise; default is 'cpu' for size>1 (the
+    single-process device mesh is always safe and enabled lazily)."""
+    plane = (env_mod.get_str(env_mod.HOROVOD_DATA_PLANE) or "cpu").lower()
+    return "cpu" if plane == "tcp" else plane
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+
+class XlaOp:
+    """Base: shares enable preconditions across the XLA op chain."""
+
+    def __init__(self, topo: ProcessTopology, mesh=None):
+        self.topo = topo
+        self.ctx = context()
+
+    def _common_enabled(self, response: Response,
+                        entries: List[TensorTableEntry]) -> bool:
+        if not self.ctx.ready:
+            return False
+        # Negotiated agreement: every rank must have submitted a device
+        # tensor (response.devices is identical on all ranks, so either
+        # every rank takes this path or none does).
+        if response.devices != [XLA_DEVICE_ID]:
+            return False
+        return all(e.tensor is not None and is_jax_array(e.tensor)
+                   for e in entries)
+
+
+class XlaAllreduce(XlaOp):
+    """Fuse → bucketed global psum → unfuse (NCCLAllreduce role,
+    ``nccl_operations.cc:126-191``)."""
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        return (response.response_type == ResponseType.ALLREDUCE
+                and self._common_enabled(response, entries))
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        ctx = self.ctx
+        np_dtype = response.tensor_type.to_numpy()
+        total = sum(int(np.prod(e.tensor.shape)) if e.tensor.shape else 1
+                    for e in entries)
+        bucket = bucket_elems(total)
+        fused = ctx.fuse(entries, bucket, np_dtype)
+        fn = ctx.allreduce_fn(bucket, np_dtype, response.prescale_factor,
+                              response.postscale_factor)
+        out = fn(ctx.global_input(fused))
+        ctx.unfuse(ctx.local_view(out), entries)
+        _count("allreduce")
+        return Status.in_progress()
+
+
+class XlaAllgather(XlaOp):
+    """Variable-dim0 allgather: pad each rank's payload into a bucket row,
+    XLA AllGather, slice + concat locally (MPI_Allgatherv role)."""
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        return (response.response_type == ResponseType.ALLGATHER
+                and len(entries) == 1
+                and self._common_enabled(response, entries))
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        import jax
+
+        ctx = self.ctx
+        entry = entries[0]
+        np_dtype = response.tensor_type.to_numpy()
+        dim0s = list(response.tensor_sizes)
+        inner = tuple(entry.tensor.shape[1:])
+        inner_n = int(np.prod(inner)) if inner else 1
+        bucket = bucket_elems(max(d * inner_n for d in dim0s))
+
+        fused = ctx.fuse([entry], bucket, np_dtype)
+        out = ctx.allgather_fn(bucket, np_dtype)(ctx.global_input(fused))
+        local = ctx.local_view(out)  # [P, bucket] on this device
+
+        key = ("ag.unpack", tuple(dim0s), inner, str(np_dtype), bucket)
+
+        def build():
+            import jax.numpy as jnp
+
+            def f(x):
+                parts = [x[r, :dim0s[r] * inner_n].reshape((dim0s[r],) + inner)
+                         for r in range(len(dim0s))]
+                return jnp.concatenate(parts, axis=0)
+            return jax.jit(f)
+
+        entry.output = ctx._get(key, build)(local)
+        _count("allgather")
+        return Status.in_progress()
+
+
+class XlaBroadcast(XlaOp):
+    """Root's buffer replicated to every process (NCCLBroadcast role)."""
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        return (response.response_type == ResponseType.BROADCAST
+                and len(entries) == 1
+                and self._common_enabled(response, entries))
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        ctx = self.ctx
+        entry = entries[0]
+        np_dtype = response.tensor_type.to_numpy()
+        total = int(np.prod(entry.tensor.shape)) if entry.tensor.shape else 1
+        bucket = bucket_elems(total)
+        fused = ctx.fuse([entry], bucket, np_dtype)
+        fn = ctx.broadcast_fn(bucket, np_dtype, entry.root_rank)
+        out = fn(ctx.global_input(fused))
+        ctx.unfuse(ctx.local_view(out), [entry])
+        _count("broadcast")
+        return Status.in_progress()

@@ -52,6 +52,9 @@ HOROVOD_ADASUM_MPI_CHUNK_SIZE = "HOROVOD_ADASUM_MPI_CHUNK_SIZE"
 HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"  # e.g. "dp:8" or "dp:4,tp:2"
 HOROVOD_XLA_BUCKET_BYTES = "HOROVOD_XLA_BUCKET_BYTES"
 HOROVOD_DATA_PLANE = "HOROVOD_DATA_PLANE"  # "xla" | "tcp" | "auto"
+# "host:port" of the jax.distributed coordination service (rank 0's
+# process); set by the launcher when the XLA data plane is requested.
+HOROVOD_JAX_COORDINATOR = "HOROVOD_JAX_COORDINATOR"
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 # Reference default cycle is 5 ms (operations.cc:458); our control plane is

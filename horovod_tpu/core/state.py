@@ -18,6 +18,7 @@ happens.
 from __future__ import annotations
 
 import atexit
+import queue
 import threading
 import time
 from typing import Callable, List, Optional
@@ -63,6 +64,10 @@ class HorovodGlobalState:
         self.timeline = None  # attached by core.timeline when enabled
         self.parameter_manager = None  # attached when autotune enabled
         self.cycle_count = 0
+        # Finalizer thread (reference gpu_operations.h:98-127): completes
+        # async device collectives so the negotiation loop never blocks.
+        self._finalize_queue: "queue.Queue" = queue.Queue()
+        self._finalizer: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
 
@@ -92,6 +97,15 @@ class HorovodGlobalState:
 
     def _build_transport(self) -> None:
         topo = self.topo
+        from ..backend import xla as xla_backend
+
+        if xla_backend.data_plane_requested() in ("xla", "auto") \
+                and topo.size > 1:
+            # jax.distributed must already be up (frameworks.jax.basics
+            # initializes it before starting this thread).
+            xla_backend.context().initialize(topo)
+        else:
+            xla_backend.context().reset()
         if topo.size == 1:
             self.mesh = None
         else:
@@ -150,6 +164,18 @@ class HorovodGlobalState:
     def _register_default_ops(self) -> None:
         topo, mesh = self.topo, self.mesh
         self.op_manager = OperationManager()
+        # XLA device ops lead each chain (reference registration order,
+        # operations.cc:145-252: most-specialized backend first); their
+        # enabled() checks the negotiated device set, so every rank makes
+        # the same choice.
+        from ..backend import xla as xla_backend
+
+        self.op_manager.register(
+            ResponseType.ALLREDUCE, xla_backend.XlaAllreduce(topo))
+        self.op_manager.register(
+            ResponseType.ALLGATHER, xla_backend.XlaAllgather(topo))
+        self.op_manager.register(
+            ResponseType.BROADCAST, xla_backend.XlaBroadcast(topo))
         self.op_manager.register(
             ResponseType.ALLREDUCE, cpu_ring.RingAllreduce(topo, mesh))
         self.op_manager.register(
@@ -200,6 +226,11 @@ class HorovodGlobalState:
             # reference draining the tensor table on shutdown.
             self._fail_all_pending("Horovod has been shut down")
         finally:
+            if self._finalizer is not None:
+                # In-flight device work must complete (and fire callbacks)
+                # before shutdown is declared done.
+                self._finalize_queue.put(None)
+                self._finalizer.join(timeout=60)
             if self.mesh is not None:
                 self.mesh.close()
             if self.timeline is not None:
@@ -267,9 +298,48 @@ class HorovodGlobalState:
             log.error("op execution failed: %s", e, exc_info=True)
             status = Status.error(f"{type(e).__name__}: {e}")
         if self.timeline is not None:
+            # For async (pending) ops this marks dispatch end; completion
+            # happens on the finalizer thread.
             self.timeline.op_end(response, entries)
+        if status.pending:
+            # Async device work dispatched: the finalizer thread waits for
+            # readiness and fires the callbacks, so this loop moves straight
+            # on to the next negotiation cycle.
+            self._ensure_finalizer()
+            self._finalize_queue.put(entries)
+            return
         for e in entries:
             e.callback(status, e)
+
+    def _ensure_finalizer(self) -> None:
+        if self._finalizer is None:
+            self._finalizer = threading.Thread(
+                target=self._finalizer_loop, name="horovod-finalizer",
+                daemon=True)
+            self._finalizer.start()
+
+    def _finalizer_loop(self) -> None:
+        while True:
+            item = self._finalize_queue.get()
+            if item is None:
+                return
+            entries = item
+            try:
+                import jax
+
+                jax.block_until_ready(
+                    [e.output for e in entries if e.output is not None])
+                status = Status.OK()
+            except Exception as e:  # noqa: BLE001
+                status = Status.error(f"XLA collective failed: {e}")
+            for e in entries:
+                try:
+                    e.callback(status, e)
+                except Exception:  # noqa: BLE001 — a raising callback must
+                    # not kill the finalizer (later collectives would hang
+                    # on a queue nobody drains)
+                    log.error("finalizer callback for %r raised",
+                              e.tensor_name, exc_info=True)
 
     def _fail_all_pending(self, msg: str) -> None:
         # Close first: an add racing the drain must fail fast, not strand.
@@ -287,6 +357,22 @@ class HorovodGlobalState:
     # ------------------------------------------------------------------
     # framework-facing enqueue API (EnqueueTensor*, operations.cc:942-1170)
     # ------------------------------------------------------------------
+
+    def _stage_tensor(self, tensor):
+        """(tensor, device_id): keep jax arrays on-device when the XLA data
+        plane is (or can be lazily made) ready; host numpy otherwise."""
+        from ..backend import xla as xla_backend
+
+        if xla_backend.is_jax_array(tensor):
+            ctx = xla_backend.context()
+            if not ctx.ready and self.topo.size == 1:
+                # Single-process mesh is always safe; build it lazily the
+                # first time a device tensor shows up (avoids touching jax
+                # device state for numpy-only users).
+                ctx.initialize(self.topo)
+            if ctx.ready:
+                return tensor, xla_backend.XLA_DEVICE_ID
+        return np.asarray(tensor), -1
 
     def _check_initialized(self) -> None:
         if not self.initialized.is_set() or self.topo is None:
@@ -309,42 +395,48 @@ class HorovodGlobalState:
                           postscale_factor: float = 1.0,
                           op: RequestType = RequestType.ALLREDUCE) -> None:
         self._check_initialized()
-        tensor = np.asarray(tensor)
+        tensor, device = self._stage_tensor(tensor)
         entry = TensorTableEntry(
             tensor_name=name, tensor=tensor, callback=callback,
-            request_type=op,
+            request_type=op, device=device,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor)
         req = Request(
             request_rank=self.topo.rank, request_type=op,
             tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
-            tensor_shape=list(tensor.shape),
+            tensor_shape=list(tensor.shape), device=device,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor)
         self.tensor_queue.add(entry, req)
 
     def enqueue_allgather(self, name: str, tensor: np.ndarray,
                           callback: Callable[[Status], None]) -> None:
         self._check_initialized()
-        tensor = np.atleast_1d(np.asarray(tensor))
+        tensor, device = self._stage_tensor(tensor)
+        if device == -1:
+            tensor = np.atleast_1d(tensor)
+        elif tensor.ndim == 0:
+            tensor = tensor.reshape(1)
         entry = TensorTableEntry(tensor_name=name, tensor=tensor,
-                                 callback=callback,
+                                 callback=callback, device=device,
                                  request_type=RequestType.ALLGATHER)
         req = Request(
             request_rank=self.topo.rank, request_type=RequestType.ALLGATHER,
             tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
-            tensor_shape=list(tensor.shape))
+            tensor_shape=list(tensor.shape), device=device)
         self.tensor_queue.add(entry, req)
 
     def enqueue_broadcast(self, name: str, tensor: np.ndarray, root_rank: int,
                           callback: Callable[[Status], None]) -> None:
         self._check_initialized()
-        tensor = np.asarray(tensor)
+        tensor, device = self._stage_tensor(tensor)
         entry = TensorTableEntry(tensor_name=name, tensor=tensor,
                                  root_rank=root_rank, callback=callback,
+                                 device=device,
                                  request_type=RequestType.BROADCAST)
         req = Request(
             request_rank=self.topo.rank, request_type=RequestType.BROADCAST,
             tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
-            tensor_shape=list(tensor.shape), root_rank=root_rank)
+            tensor_shape=list(tensor.shape), root_rank=root_rank,
+            device=device)
         self.tensor_queue.add(entry, req)
 
     def enqueue_alltoall(self, name: str, tensor: np.ndarray,

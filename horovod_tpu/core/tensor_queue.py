@@ -8,9 +8,10 @@ background thread pops requests each cycle and later claims entries named by
 a negotiated Response.  Duplicate in-flight names are an error
 (``DUPLICATE_NAME_ERROR``, ``common.h:164-167``).
 
-Entries hold host-side numpy buffers.  The XLA data plane stages device
-arrays in/out of these buffers; keeping the queue numpy-only keeps the
-controller completely framework-agnostic.
+Entries hold host numpy buffers on the TCP data plane, or jax device
+arrays on the XLA data plane (``entry.device`` distinguishes them and the
+controller negotiates agreement); the controller itself only reads
+shape/dtype metadata, staying framework-agnostic.
 """
 
 from __future__ import annotations
@@ -29,10 +30,19 @@ from .messages import Request, RequestType, Response
 class Status:
     ok: bool = True
     error_message: str = ""
+    # True when the op dispatched async device work: outputs are unready
+    # arrays and callbacks fire from the finalizer thread once the device
+    # signals completion (reference IN_PROGRESS + finalizer-thread design,
+    # ``gpu_operations.h:98-127``).
+    pending: bool = False
 
     @staticmethod
     def OK() -> "Status":
         return Status(True, "")
+
+    @staticmethod
+    def in_progress() -> "Status":
+        return Status(True, "", pending=True)
 
     @staticmethod
     def error(msg: str) -> "Status":

@@ -16,12 +16,56 @@ from ...core.state import global_state, reset_global_state
 from ...transport.store import Store
 
 
+def _maybe_init_jax_distributed(topology: Optional[ProcessTopology]) -> None:
+    """When the XLA data plane is requested for a multi-process world, bring
+    up jax's multi-controller runtime (the ``ncclCommInitRank`` analog)
+    BEFORE any jax device is touched.  The launcher distributes the
+    coordinator address via ``HOROVOD_JAX_COORDINATOR``."""
+    from ...backend import xla as xla_backend
+    from ...common import env as env_mod
+    from ...common.topology import from_env
+
+    plane = xla_backend.data_plane_requested()
+    if plane not in ("xla", "auto"):
+        return
+    topo = topology or from_env()
+    if topo.size <= 1:
+        return
+    import jax
+
+    if jax.distributed.is_initialized():
+        return
+    coord = env_mod.get_str(env_mod.HOROVOD_JAX_COORDINATOR)
+    if not coord:
+        if plane == "xla":
+            # An explicit request must fail loudly, not degrade silently.
+            raise RuntimeError(
+                "HOROVOD_DATA_PLANE=xla but HOROVOD_JAX_COORDINATOR is "
+                "unset (launch with `hvdrun --data-plane xla`)")
+        return  # auto: quietly stay on the TCP plane
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=topo.size,
+                                   process_id=topo.rank)
+    except Exception as e:  # noqa: BLE001
+        if plane == "xla":
+            raise HorovodInternalError(
+                f"jax.distributed init failed for the requested XLA data "
+                f"plane: {e}") from e
+        from ...common.logging_util import get_logger
+
+        get_logger("horovod_tpu.basics").warning(
+            "jax.distributed init failed (%s); eager collectives will use "
+            "the TCP data plane", e)
+
+
 def init(store: Optional[Store] = None,
          topology: Optional[ProcessTopology] = None) -> None:
     """Initialize the runtime: topology from the launcher env (or given
     explicitly), TCP mesh rendezvous when size > 1, background thread up.
 
     Reference: ``hvd.init()`` → ``horovod_init`` (``operations.cc:752``)."""
+    _maybe_init_jax_distributed(topology)
     global_state().initialize(store=store, topology=topology)
     from ...common import env as env_mod
 

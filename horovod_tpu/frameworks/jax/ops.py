@@ -47,15 +47,17 @@ def _auto_name(kind: str, name: Optional[str]) -> str:
 
 
 def _to_numpy(tensor: Any):
-    """Returns (np_array, rehydrate_fn)."""
+    """Returns (tensor, rehydrate_fn).  jax arrays pass through unchanged —
+    the core decides per-tensor whether they stay on device (XLA data
+    plane) or are staged to host (TCP plane); either way a jax caller gets
+    a jax array back."""
     try:
         import jax
 
         if isinstance(tensor, jax.Array):
-            np_val = np.asarray(jax.device_get(tensor))
             import jax.numpy as jnp
 
-            return np_val, jnp.asarray
+            return tensor, jnp.asarray
     except ImportError:  # pragma: no cover
         pass
     return np.asarray(tensor), lambda out: out

@@ -1,0 +1,134 @@
+"""Keras binding: callbacks + DistributedOptimizer re-export.
+
+Role of the reference's ``horovod/keras/__init__.py`` + ``_keras/callbacks.py``
+(BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateWarmupCallback): thin layer binding the TensorFlow collectives
+into the Keras training loop.  Works with Keras 3 (multi-backend).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..tensorflow import (
+    Adasum,
+    Average,
+    Compression,
+    DistributedOptimizer,
+    Sum,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_object,
+    init,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def broadcast_global_variables(model, root_rank: int = 0) -> None:
+    """Set every model weight to the root's value (reference
+    ``keras/__init__.py broadcast_global_variables``)."""
+    weights = model.get_weights()
+    synced = [np.asarray(broadcast(w, root_rank, name=f"keras.bcast.{i}"))
+              for i, w in enumerate(weights)]
+    model.set_weights(synced)
+
+
+def _keras_callback_base():
+    import keras
+
+    return keras.callbacks.Callback
+
+
+class BroadcastGlobalVariablesCallback(_keras_callback_base()):
+    """Broadcast initial weights from root at train begin (reference
+    ``_keras/callbacks.py:24-46``) so all ranks start identical."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if self._done:
+            return
+        broadcast_global_variables(self.model, self.root_rank)
+        self._done = True
+
+
+class MetricAverageCallback(_keras_callback_base()):
+    """Allreduce-average epoch metrics across ranks (reference
+    ``_keras/callbacks.py:48-92``) so logs/early-stopping agree."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or size() == 1:
+            return
+        for k in sorted(logs):
+            v = logs[k]
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                logs[k] = float(np.asarray(allreduce(
+                    np.asarray(v, np.float64), op=Average,
+                    name=f"metric.{epoch}.{k}")))
+
+
+class LearningRateWarmupCallback(_keras_callback_base()):
+    """Linear LR warmup from lr/size to lr over N epochs (reference
+    ``_keras/callbacks.py:94-170``): large-batch training recipe from the
+    Facebook 1-hour paper."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        self._current_epoch = 0
+
+    def _set_lr(self, lr: float) -> None:
+        opt = self.model.optimizer
+        # DistributedOptimizer delegates attribute access to the wrapped opt
+        if hasattr(opt, "learning_rate"):
+            opt.learning_rate = lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._current_epoch = epoch
+        if epoch >= self.warmup_epochs or size() == 1:
+            return
+        progress = (epoch + 1) / self.warmup_epochs
+        lr = self.initial_lr / size() * (
+            (size() - 1) * progress + 1)
+        self._set_lr(lr)
+        if self.verbose and rank() == 0:
+            print(f"LearningRateWarmup: epoch {epoch}, lr={lr:.6f}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch + 1 == self.warmup_epochs:
+            self._set_lr(self.initial_lr)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None):
+    """Load a Keras model and rewrap its optimizer as distributed
+    (reference ``keras/__init__.py:143``)."""
+    import keras
+
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects)
+    model.optimizer = DistributedOptimizer(model.optimizer)
+    return model
+
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "allreduce", "allgather", "broadcast", "broadcast_object",
+    "broadcast_global_variables", "DistributedOptimizer", "Compression",
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateWarmupCallback", "load_model",
+    "Sum", "Average", "Adasum",
+]

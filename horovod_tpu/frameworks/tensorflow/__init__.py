@@ -1,0 +1,297 @@
+"""TensorFlow 2 binding.
+
+Role of the reference's ``horovod/tensorflow/__init__.py`` (629 LoC) +
+``mpi_ops.py``: the same public surface — ``init/rank/size/...``,
+``allreduce/allgather/broadcast/alltoall`` on eager tensors (graph mode via
+``tf.py_function``), gradient registration (allreduce's gradient is
+allreduce, ``mpi_ops.py:117-218``), ``DistributedOptimizer`` /
+``DistributedGradientTape`` (``__init__.py:293-366, 564-629``),
+``broadcast_variables``, ``broadcast_object`` / ``allgather_object``
+(``functions.py``), and fp16/bf16 ``Compression``.
+
+TPU-first difference: there is no custom C++ TF op — eager TF tensors are
+host tensors here (TF is the *compatibility* surface; the native fast path
+is jax), so tensors bridge via numpy into the same core enqueue API every
+other binding uses.  Semantics (naming, averaging as postscale 1/size,
+IndexedSlices→allgather) match the reference.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import HorovodInternalError
+from ..jax.basics import (
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+)
+from ..jax.ops import (
+    Adasum,
+    Average,
+    Sum,
+    barrier,
+    join,
+    poll,
+    synchronize,
+)
+from ..jax import ops as _core_ops
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    tf = _tf()
+    if isinstance(tensor, tf.Tensor) or isinstance(tensor, tf.Variable):
+        return tensor.numpy()
+    return np.asarray(tensor)
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[str] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Eager allreduce of a tf.Tensor (or IndexedSlices, which take the
+    reference's allgather path, ``tensorflow/__init__.py:92-108``)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        if op == Adasum:
+            raise NotImplementedError(
+                "IndexedSlices + Adasum is unsupported (reference parity)")
+        # allgather values and indices; average divides by size
+        values = allgather(tensor.values, name=(name or "") + ".values" if name else None)
+        indices = allgather(tensor.indices, name=(name or "") + ".indices" if name else None)
+        if average or (average is None and op in (None, Average)):
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    out = _core_ops.allreduce(
+        _to_numpy(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def allgather(tensor, name: Optional[str] = None):
+    tf = _tf()
+    out = _core_ops.allgather(_to_numpy(tensor), name=name)
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    tf = _tf()
+    out = _core_ops.broadcast(_to_numpy(tensor), root_rank, name=name)
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def alltoall(tensor, splits: Optional[List[int]] = None,
+             name: Optional[str] = None):
+    tf = _tf()
+    out = _core_ops.alltoall(_to_numpy(tensor), splits=splits, name=name)
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+# ---------------------------------------------------------------------------
+# variables / objects
+# ---------------------------------------------------------------------------
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable the root's value (reference
+    ``functions.py broadcast_variables``)."""
+    tf = _tf()
+    for i, v in enumerate(variables):
+        name = f"bcast.var.{i}.{getattr(v, 'name', i)}"
+        out = broadcast(v, root_rank, name=name)
+        v.assign(tf.reshape(tf.cast(out, v.dtype), v.shape))
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    from ..jax.functions import broadcast_object as _bo
+
+    return _bo(obj, root_rank=root_rank, name=name or "tf.bcast_obj")
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    from ..jax.functions import allgather_object as _ao
+
+    return _ao(obj, name=name or "tf.allgather_obj")
+
+
+# ---------------------------------------------------------------------------
+# compression
+# ---------------------------------------------------------------------------
+
+
+class Compression:
+    """fp16-on-the-wire compression (reference ``compression.py:33-74``)."""
+
+    class none:
+        @staticmethod
+        def compress(tensor):
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    class fp16:
+        @staticmethod
+        def compress(tensor):
+            tf = _tf()
+            if tensor.dtype in (tf.float32, tf.float64):
+                return tf.cast(tensor, tf.float16), tensor.dtype
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            tf = _tf()
+            return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+# ---------------------------------------------------------------------------
+# DistributedGradientTape / DistributedOptimizer
+# ---------------------------------------------------------------------------
+
+
+class _DistributedGradientTape:
+    """Wraps tf.GradientTape: ``gradient()`` allreduces every grad
+    (reference ``tensorflow/__init__.py:564-629``)."""
+
+    def __init__(self, tape, compression=None, op: str = Average,
+                 prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+        self._tape = tape
+        self._compression = compression or Compression.none
+        self._op = op
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        return _allreduce_grads(grads, self._compression, self._op,
+                                self._prescale, self._postscale)
+
+
+def DistributedGradientTape(tape, compression=None, op: str = Average,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0):
+    return _DistributedGradientTape(tape, compression, op,
+                                    prescale_factor, postscale_factor)
+
+
+def _allreduce_grads(grads, compression, op, prescale, postscale):
+    tf = _tf()
+    out = []
+    for i, g in enumerate(grads):
+        if g is None:
+            out.append(None)
+            continue
+        if isinstance(g, tf.IndexedSlices):
+            out.append(allreduce(g, op=op, name=f"grad.{i}"))
+            continue
+        comp, ctx = compression.compress(g)
+        red = allreduce(comp, op=op, name=f"grad.{i}",
+                        prescale_factor=prescale, postscale_factor=postscale)
+        out.append(compression.decompress(red, ctx))
+    return out
+
+
+def DistributedOptimizer(optimizer, compression=None, op: str = Average,
+                         backward_passes_per_step: int = 1,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0):
+    """Allreduce gradients before applying them.
+
+    Like the reference (``tensorflow/__init__.py:465-561``), this returns a
+    DYNAMIC SUBCLASS of the wrapped optimizer's own class — Keras validates
+    optimizer identity at ``compile()``, so a duck-typed wrapper is
+    rejected.  The hook point is ``apply_gradients`` (Keras 3 removed
+    ``get_gradients``); ``backward_passes_per_step`` gives local gradient
+    aggregation (reference ``gradient_aggregation.py``) with the allreduce
+    firing every Nth step.
+    """
+    comp = compression or Compression.none
+    bpps = max(1, backward_passes_per_step)
+    base = optimizer.__class__
+
+    class _DistributedKerasOptimizer(base):
+        _hvd_agg = None
+        _hvd_counter = 0
+
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            tf = _tf()
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            tvars = [v for _, v in grads_and_vars]
+            if bpps > 1:
+                if self._hvd_agg is None:
+                    self._hvd_agg = [
+                        tf.Variable(tf.zeros_like(g), trainable=False)
+                        if g is not None else None for g in grads]
+                for a, g in zip(self._hvd_agg, grads):
+                    if a is not None and g is not None:
+                        a.assign_add(g)
+                self._hvd_counter += 1
+                if self._hvd_counter < bpps:
+                    return None
+                grads = [a / bpps if a is not None else None
+                         for a in self._hvd_agg]
+            reduced = _allreduce_grads(grads, comp, op,
+                                       prescale_factor, postscale_factor)
+            result = super().apply_gradients(zip(reduced, tvars), **kwargs)
+            if bpps > 1:
+                for a in self._hvd_agg:
+                    if a is not None:
+                        a.assign(tf.zeros_like(a))
+                self._hvd_counter = 0
+            return result
+
+    _DistributedKerasOptimizer.__name__ = f"Distributed{base.__name__}"
+    if hasattr(optimizer, "get_config") and hasattr(base, "from_config"):
+        return _DistributedKerasOptimizer.from_config(optimizer.get_config())
+    raise TypeError(
+        f"cannot wrap optimizer of type {base.__name__}: no "
+        f"get_config/from_config (reference requires a Keras optimizer)")
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "is_homogeneous",
+    "start_timeline", "stop_timeline",
+    "allreduce", "allgather", "broadcast", "alltoall", "join", "barrier",
+    "poll", "synchronize",
+    "broadcast_variables", "broadcast_object", "allgather_object",
+    "Compression", "DistributedOptimizer", "DistributedGradientTape",
+    "Sum", "Average", "Adasum",
+]

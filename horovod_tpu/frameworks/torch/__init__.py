@@ -1,0 +1,360 @@
+"""PyTorch binding.
+
+Role of the reference's ``horovod/torch`` (``mpi_ops.py:85-630``,
+``optimizer.py:103-200``, ``functions.py:30-257``): async handle-based
+collectives (``allreduce_async_`` / ``synchronize``), a
+``DistributedOptimizer`` with WFBP gradient hooks that allreduce each
+gradient as soon as backprop produces it, ``backward_passes_per_step``
+microbatching, ``broadcast_parameters`` / ``broadcast_optimizer_state``,
+and fp16 compression.
+
+TPU-first difference: no pybind11 extension — torch here is the
+*compatibility* surface (CPU tensors bridge via numpy into the core
+enqueue API; the native fast path is jax).  The WFBP overlap still works:
+hooks enqueue during backward, ``optimizer.step()`` synchronizes, so
+communication overlaps the remaining backprop exactly as in the reference
+design (``optimizer.py:133-149``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import HorovodInternalError
+from ..jax.basics import (
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+)
+from ..jax.ops import Adasum, Average, Sum, barrier, join, poll
+from ..jax import ops as _core_ops
+from ..jax.ops import _handles
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    torch = _torch()
+    if isinstance(tensor, torch.Tensor):
+        return tensor.detach().cpu().numpy()
+    return np.asarray(tensor)
+
+
+def synchronize(handle: int):
+    """Wait for an async op; returns a torch tensor (reference
+    ``mpi_ops.py:608-630``)."""
+    torch = _torch()
+    out = _handles.wait(handle)
+    if isinstance(out, tuple):  # alltoall returns (tensor, splits)
+        return torch.from_numpy(np.ascontiguousarray(np.asarray(out[0])))
+    return torch.from_numpy(np.ascontiguousarray(np.asarray(out)))
+
+
+# ---------------------------------------------------------------------------
+# async + blocking collectives (reference mpi_ops.py:85-630)
+# ---------------------------------------------------------------------------
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[str] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    return _core_ops.allreduce_async(
+        _to_numpy(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[str] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    return synchronize(allreduce_async(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor))
+
+
+def allreduce_async_(tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     op: Optional[str] = None) -> int:
+    """In-place flavor: on synchronize the result is copied back into
+    ``tensor`` (reference ``allreduce_async_``)."""
+    handle = allreduce_async(tensor, average=average, name=name, op=op)
+    _INPLACE_TARGETS[handle] = tensor
+    return handle
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: Optional[str] = None):
+    return synchronize_(allreduce_async_(tensor, average=average,
+                                         name=name, op=op))
+
+
+_INPLACE_TARGETS: Dict[int, Any] = {}
+
+
+def synchronize_(handle: int):
+    """Synchronize an in-place handle: copies the result into the submitted
+    tensor and returns it."""
+    torch = _torch()
+    out = synchronize(handle)
+    target = _INPLACE_TARGETS.pop(handle, None)
+    if target is not None:
+        with torch.no_grad():
+            target.copy_(out.reshape(target.shape))
+        return target
+    return out
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    return _core_ops.allgather_async(_to_numpy(tensor), name=name)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
+    return _core_ops.broadcast_async(_to_numpy(tensor), root_rank, name=name)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+def broadcast_async_(tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    handle = broadcast_async(tensor, root_rank, name=name)
+    _INPLACE_TARGETS[handle] = tensor
+    return handle
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None):
+    return synchronize_(broadcast_async_(tensor, root_rank, name=name))
+
+
+def alltoall(tensor, splits: Optional[List[int]] = None,
+             name: Optional[str] = None):
+    torch = _torch()
+    out = _core_ops.alltoall(_to_numpy(tensor), splits=splits, name=name)
+    return torch.from_numpy(np.ascontiguousarray(np.asarray(out)))
+
+
+# ---------------------------------------------------------------------------
+# parameters / optimizer state broadcast (reference functions.py)
+# ---------------------------------------------------------------------------
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a ``state_dict`` or named-parameter iterable
+    (reference ``functions.py:30``)."""
+    torch = _torch()
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        handles.append(broadcast_async_(p.data, root_rank,
+                                        name=f"bcast.param.{name}"))
+    for h in handles:
+        synchronize_(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast optimizer state tensors + hyperparameters from root
+    (reference ``functions.py:62``: rebuilds the state dict as tensors)."""
+    torch = _torch()
+    state_dict = optimizer.state_dict()
+
+    # Hyperparameters (lr, momentum, ...) travel as one pickled object.
+    from ..jax.functions import broadcast_object
+
+    pg = broadcast_object(state_dict["param_groups"], root_rank=root_rank,
+                          name="bcast.opt.param_groups")
+    state_dict["param_groups"] = pg
+
+    # Tensor state entries broadcast in place; non-tensor scalars pickle.
+    scalars = {}
+    for pid, pstate in sorted(state_dict.get("state", {}).items()):
+        for k, v in sorted(pstate.items()):
+            if isinstance(v, torch.Tensor) and v.numel() > 0:
+                broadcast_(v, root_rank, name=f"bcast.opt.{pid}.{k}")
+            else:
+                scalars[(pid, k)] = v
+    synced = broadcast_object(scalars, root_rank=root_rank,
+                              name="bcast.opt.scalars")
+    for (pid, k), v in synced.items():
+        state_dict["state"][pid][k] = v
+    optimizer.load_state_dict(state_dict)
+
+
+# ---------------------------------------------------------------------------
+# compression
+# ---------------------------------------------------------------------------
+
+
+class Compression:
+    class none:
+        @staticmethod
+        def compress(tensor):
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    class fp16:
+        @staticmethod
+        def compress(tensor):
+            torch = _torch()
+            if tensor.dtype in (torch.float32, torch.float64):
+                return tensor.half(), tensor.dtype
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor.to(ctx) if ctx is not None else tensor
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer with WFBP hooks (reference optimizer.py:103-200)
+# ---------------------------------------------------------------------------
+
+
+class _DistributedOptimizer:
+    def __init__(self, optimizer, named_parameters=None, compression=None,
+                 backward_passes_per_step: int = 1, op: str = Average):
+        self._opt = optimizer
+        self._compression = compression or Compression.none
+        self._op = op
+        self._bpps = max(1, backward_passes_per_step)
+        self._counters: Dict[str, int] = {}
+        self._handles: Dict[str, int] = {}
+        self._grad_accs = []  # keep hook owners alive (reference :103-112)
+        self._require_sync = False
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = []
+            for gi, group in enumerate(optimizer.param_groups):
+                named.extend((f"group{gi}.param{pi}", p)
+                             for pi, p in enumerate(group["params"]))
+        self._named: List = [(n, p) for n, p in named if p.requires_grad]
+        dup = len({n for n, _ in self._named}) != len(self._named)
+        if dup:
+            raise ValueError("named_parameters contains duplicate names")
+        self._register_hooks()
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    # -- WFBP machinery -------------------------------------------------
+
+    def _register_hooks(self) -> None:
+        """Hook each param's grad accumulator: the hook fires the moment
+        autograd finishes that param's gradient, so the allreduce overlaps
+        the rest of backprop (reference ``_register_hooks``/``_make_hook``,
+        ``optimizer.py:103-149``)."""
+        torch = _torch()
+        for name, p in self._named:
+            tmp = p.expand_as(p)
+            grad_acc = tmp.grad_fn.next_functions[0][0]
+            grad_acc.register_hook(self._make_hook(name, p))
+            self._grad_accs.append(grad_acc)
+
+    def _make_hook(self, name: str, p):
+        def hook(*ignore):
+            if name in self._handles:
+                raise HorovodInternalError(
+                    f"gradient for {name} allreduced twice before step(); "
+                    "increase backward_passes_per_step for gradient "
+                    "accumulation (reference optimizer.py:136-141)")
+            self._require_sync = True
+            count = self._counters.get(name, 0) + 1
+            self._counters[name] = count
+            if count < self._bpps:
+                return
+            self._counters[name] = 0
+            self._handles[name] = self._allreduce_grad_async(name, p)
+        return hook
+
+    def _allreduce_grad_async(self, name: str, p) -> int:
+        comp, ctx = self._compression.compress(p.grad)
+        handle = allreduce_async(
+            comp, op=self._op, name=f"wfbp.{name}",
+            postscale_factor=1.0 / self._bpps)
+        self._ctx_for = getattr(self, "_ctx_for", {})
+        self._ctx_for[name] = ctx
+        return handle
+
+    def synchronize(self) -> None:
+        """Wait for all hooked allreduces and write back grads (reference
+        ``optimizer.py:151-200``)."""
+        torch = _torch()
+        missing = [(n, p) for n, p in self._named
+                   if n not in self._handles and self._counters.get(n, 0) == 0
+                   and p.grad is not None and self._require_sync]
+        # Params whose hook never fired this step (e.g. frozen branches)
+        # are skipped, like the reference's missing-handle path.
+        for name, handle in list(self._handles.items()):
+            out = synchronize(handle)
+            p = dict(self._named)[name]
+            ctx = getattr(self, "_ctx_for", {}).get(name)
+            out = self._compression.decompress(out, ctx)
+            with torch.no_grad():
+                p.grad.copy_(out.reshape(p.grad.shape).to(p.grad.dtype))
+        self._handles.clear()
+        self._require_sync = False
+        del missing
+
+    def step(self, closure=None):
+        if self._require_sync:
+            self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise HorovodInternalError(
+                "zero_grad() called while allreduces are outstanding; call "
+                "step() or synchronize() first (reference "
+                "optimizer.py:202-207)")
+        return self._opt.zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None, compression=None,
+                         backward_passes_per_step: int = 1,
+                         op: str = Average):
+    return _DistributedOptimizer(
+        optimizer, named_parameters=named_parameters, compression=compression,
+        backward_passes_per_step=backward_passes_per_step, op=op)
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "is_homogeneous",
+    "start_timeline", "stop_timeline",
+    "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+    "allgather", "allgather_async", "broadcast", "broadcast_async",
+    "broadcast_", "broadcast_async_", "alltoall", "join", "barrier",
+    "poll", "synchronize", "synchronize_",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "Compression", "DistributedOptimizer",
+    "Sum", "Average", "Adasum",
+]

@@ -1,0 +1,5 @@
+"""`import horovod_tpu.keras as hvd` — reference-parity alias for the
+Keras binding (reference exposes `horovod.keras`)."""
+
+from .frameworks.keras import *  # noqa: F401,F403
+from .frameworks.keras import __all__  # noqa: F401

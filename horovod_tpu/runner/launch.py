@@ -140,6 +140,28 @@ class _OutputPump(threading.Thread):
                 self._tee.close()
 
 
+def _pick_coordinator_port(probe: bool) -> int:
+    """A port for rank 0's jax.distributed coordinator, below the Linux
+    ephemeral range (32768+) to dodge transient clashes; when the
+    coordinator host is this machine, bind-probe for availability."""
+    import random
+    import socket
+
+    for _ in range(32):
+        port = random.randint(20000, 32000)
+        if not probe:
+            return port
+        s = socket.socket()
+        try:
+            s.bind(("0.0.0.0", port))
+            return port
+        except OSError:
+            continue
+        finally:
+            s.close()
+    raise RuntimeError("no free port found for the jax coordinator")
+
+
 def launch_job(args, command: List[str]) -> int:
     hosts_str = args.hosts
     if args.hostfile:
@@ -161,6 +183,15 @@ def launch_job(args, command: List[str]) -> int:
     any_remote = any(not _is_local(s.hostname) for s in slots)
     rdv_addr = _default_advertise_addr() if any_remote else "127.0.0.1"
     extra = config_parser.env_from_args(args)
+    if (args.data_plane or "").lower() in ("xla", "auto"):
+        # The jax.distributed coordination service runs inside rank 0's
+        # process; every worker needs its address before first device use.
+        coord_host = slots[0].hostname
+        local_coord = _is_local(coord_host)
+        if local_coord:
+            coord_host = rdv_addr
+        extra[env_mod.HOROVOD_JAX_COORDINATOR] = \
+            f"{coord_host}:{_pick_coordinator_port(probe=local_coord)}"
 
     procs: List[subprocess.Popen] = []
     pumps: List[_OutputPump] = []

@@ -1,0 +1,5 @@
+"""`import horovod_tpu.tensorflow as hvd` — reference-parity alias for the
+TensorFlow binding (reference exposes `horovod.tensorflow`)."""
+
+from .frameworks.tensorflow import *  # noqa: F401,F403
+from .frameworks.tensorflow import __all__  # noqa: F401

@@ -1,0 +1,5 @@
+"""`import horovod_tpu.torch as hvd` — reference-parity alias for the
+PyTorch binding (reference exposes `horovod.torch`)."""
+
+from .frameworks.torch import *  # noqa: F401,F403
+from .frameworks.torch import __all__  # noqa: F401

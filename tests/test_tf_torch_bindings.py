@@ -1,0 +1,168 @@
+"""TensorFlow / PyTorch / Keras binding tests under real worker processes.
+
+Mirrors the reference's parallel tier (``test/parallel/test_tensorflow.py``,
+``test_torch.py``): same test bodies for collectives, gradient wrappers and
+parameter broadcast, executed with a 2-process launcher.
+"""
+
+import pytest
+
+from .helpers import run_distributed
+
+tf = pytest.importorskip("tensorflow")
+torch = pytest.importorskip("torch")
+
+
+def test_tf_collectives_and_tape():
+    out = run_distributed(2, """
+import os
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = "2"
+import tensorflow as tf
+import horovod_tpu.tensorflow as htf
+
+t = tf.constant([1.0, 2.0]) * (rank + 1)
+o = htf.allreduce(t, op=htf.Sum, name="t")
+assert np.allclose(o.numpy(), [3.0, 6.0]), o
+
+# averaging gradient tape
+w = tf.Variable([[1.0 + rank]])
+with htf.DistributedGradientTape(tf.GradientTape()) as tape:
+    loss = tf.reduce_sum(w * w) * (rank + 1)
+g = tape.gradient(loss, [w])
+exp = np.mean([2 * (1.0 + r) * (r + 1) for r in range(size)])
+assert np.allclose(g[0].numpy(), exp), (g[0].numpy(), exp)
+
+# broadcast_variables handles scalars and arrays
+v0 = tf.Variable(float(rank + 5))
+v1 = tf.Variable(np.full((2, 2), float(rank), np.float32))
+htf.broadcast_variables([v0, v1], root_rank=1)
+assert np.allclose(v0.numpy(), 6.0) and np.allclose(v1.numpy(), 1.0)
+
+# IndexedSlices take the allgather path
+iv = tf.IndexedSlices(tf.ones([2, 3]) * (rank + 1),
+                      tf.constant([0, 1]), tf.constant([4, 3]))
+red = htf.allreduce(iv, op=htf.Average)
+assert red.values.shape[0] == 2 * size
+print("TFBIND_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TFBIND_OK {r}" in o
+
+
+def test_tf_distributed_optimizer_keras_compile():
+    """The dynamic-subclass optimizer passes Keras compile() validation and
+    keeps ranks in lockstep through fit()."""
+    out = run_distributed(2, """
+import os
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = "2"
+import keras
+import tensorflow as tf
+import horovod_tpu.tensorflow as htf
+import horovod_tpu.keras as hk
+
+model = keras.Sequential([keras.layers.Input((4,)), keras.layers.Dense(2)])
+opt = htf.DistributedOptimizer(keras.optimizers.SGD(0.1))
+model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+rng = np.random.RandomState(rank)
+x = rng.randn(32, 4).astype("float32")
+y = np.zeros((32, 2), "float32")
+model.fit(x, y, epochs=1, batch_size=16, verbose=0,
+          callbacks=[hk.BroadcastGlobalVariablesCallback(0)])
+w = model.get_weights()[0]
+g = np.asarray(htf.allgather(tf.constant(w.ravel()[None]), name="wchk"))
+assert np.allclose(g[0], g[1], atol=1e-6), "ranks diverged"
+print("TFOPT_OK", rank, flush=True)
+""", timeout=300)
+    for r, o in enumerate(out):
+        assert f"TFOPT_OK {r}" in o
+
+
+def test_torch_wfbp_optimizer_and_state_broadcast():
+    out = run_distributed(2, """
+import torch
+import torch.nn.functional as F
+import horovod_tpu.torch as ht
+
+torch.manual_seed(42 + rank)
+model = torch.nn.Sequential(torch.nn.Linear(8, 16), torch.nn.ReLU(),
+                            torch.nn.Linear(16, 4))
+opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+opt = ht.DistributedOptimizer(opt,
+                              named_parameters=model.named_parameters())
+ht.broadcast_parameters(model.state_dict(), root_rank=0)
+ht.broadcast_optimizer_state(opt, root_rank=0)
+
+x = torch.randn(16, 8) + rank
+y = torch.randint(0, 4, (16,))
+for _ in range(3):
+    opt.zero_grad()
+    F.cross_entropy(model(x), y).backward()
+    opt.step()
+
+p = list(model.parameters())[0].detach().numpy().ravel()[:8]
+g = ht.allgather(torch.from_numpy(p[None, :]), name="chk").numpy()
+assert np.allclose(g[0], g[1], atol=1e-6), "WFBP ranks diverged"
+
+# zero_grad with outstanding handles raises (reference optimizer.py:202)
+opt.zero_grad()
+loss = F.cross_entropy(model(x), y)
+loss.backward()           # hooks fire -> handles outstanding
+try:
+    opt.zero_grad()
+    raise SystemExit("expected HorovodInternalError")
+except Exception as e:
+    assert "outstanding" in str(e), e
+opt.step()
+print("TORCHOPT_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TORCHOPT_OK {r}" in o
+
+
+def test_torch_backward_passes_per_step():
+    """Gradient accumulation: allreduce fires every Nth backward, hooks do
+    not raise on intermediate passes."""
+    out = run_distributed(2, """
+import torch
+import torch.nn.functional as F
+import horovod_tpu.torch as ht
+
+torch.manual_seed(7)
+model = torch.nn.Linear(4, 2)
+opt = torch.optim.SGD(model.parameters(), lr=0.1)
+opt = ht.DistributedOptimizer(opt, named_parameters=model.named_parameters(),
+                              backward_passes_per_step=2)
+ht.broadcast_parameters(model.state_dict(), root_rank=0)
+x = torch.randn(8, 4)
+y = torch.zeros(8, 2)
+for _ in range(2):   # two backwards per step
+    F.mse_loss(model(x), y).backward()
+opt.step()
+opt.zero_grad()
+p = list(model.parameters())[0].detach().numpy().ravel()
+g = ht.allgather(torch.from_numpy(p[None, :]), name="chk").numpy()
+assert np.allclose(g[0], g[1], atol=1e-6)
+print("BPPS_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"BPPS_OK {r}" in o
+
+
+def test_torch_inplace_and_alltoall():
+    out = run_distributed(2, """
+import torch
+import horovod_tpu.torch as ht
+
+t = torch.ones(4) * (rank + 1)
+ht.allreduce_(t, op=ht.Sum, name="ip")
+assert np.allclose(t.numpy(), 3.0)
+
+a = torch.arange(4, dtype=torch.float32) + 10 * rank
+o = ht.alltoall(a, name="a2a")
+exp = np.concatenate([np.arange(2) + 2 * rank,
+                      np.arange(2) + 2 * rank + 10])
+assert np.allclose(o.numpy(), exp), (o, exp)
+print("TINPLACE_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TINPLACE_OK {r}" in o
