@@ -84,6 +84,19 @@ class XlaContext:
     def initialize(self, topo: ProcessTopology) -> None:
         self.ready = False
         self.topo = topo
+        # 'xla' is a hard request: misconfiguration must raise, not quietly
+        # run eager collectives over the host TCP ring at a fraction of the
+        # bandwidth ('auto' is the opportunistic flavor).
+        strict = data_plane_requested() == "xla"
+
+        def _fail(msg: str, *fmt) -> None:
+            if strict:
+                from ..common.exceptions import HorovodInternalError
+
+                raise HorovodInternalError(
+                    "HOROVOD_DATA_PLANE=xla but " + (msg % fmt))
+            log.warning(msg + "; falling back to the TCP data plane", *fmt)
+
         try:
             import jax
             from jax.sharding import Mesh
@@ -94,17 +107,14 @@ class XlaContext:
                 self.ready = True
                 return
             if not jax.distributed.is_initialized():
-                log.warning(
-                    "XLA data plane requested but jax.distributed is not "
-                    "initialized; falling back to the TCP data plane")
+                _fail("jax.distributed is not initialized")
                 return
             if jax.process_count() != topo.size or \
                     jax.process_index() != topo.rank:
-                log.warning(
-                    "XLA data plane topology mismatch (jax procs=%d/%d vs "
-                    "horovod %d/%d); falling back to TCP",
-                    jax.process_index(), jax.process_count(),
-                    topo.rank, topo.size)
+                _fail("XLA data plane topology mismatch (jax procs=%d/%d "
+                      "vs horovod %d/%d)",
+                      jax.process_index(), jax.process_count(),
+                      topo.rank, topo.size)
                 return
             # One device per process: the eager plane stages each rank's
             # contribution on its first local device (process-per-chip
@@ -115,8 +125,7 @@ class XlaContext:
                 per_proc.setdefault(d.process_index, d)
             devs = [per_proc[p] for p in sorted(per_proc)]
             if len(devs) != topo.size:
-                log.warning("XLA data plane: %d jax processes != world %d",
-                            len(devs), topo.size)
+                _fail("%d jax processes != world %d", len(devs), topo.size)
                 return
             self.device = per_proc[topo.rank]
             self.mesh = Mesh(np.array(devs), ("proc",))
@@ -124,6 +133,8 @@ class XlaContext:
             log.info("XLA eager data plane up: %d-process mesh on %s",
                      topo.size, self.device.platform)
         except Exception as e:  # noqa: BLE001
+            if strict:
+                raise
             log.warning("XLA data plane unavailable (%s); using TCP", e)
             self.ready = False
 

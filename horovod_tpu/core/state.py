@@ -121,6 +121,10 @@ class HorovodGlobalState:
             # Epoch-scoped keys so elastic re-init never reads stale peer
             # addresses from a previous incarnation of the job.
             epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+            # Check-in mark for the launcher's --start-timeout watchdog
+            # (reference: workers surface through the rendezvous server and
+            # horovodrun aborts if they don't within the timeout).
+            store.set("worker_started", str(topo.rank), b"1")
             self.mesh = TcpMesh(topo.rank, topo.size, store,
                                 scope=f"tcp.{epoch}")
         fusion = env_mod.get_int(

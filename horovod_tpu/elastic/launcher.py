@@ -43,9 +43,14 @@ def launch_elastic_job(args, command: List[str]) -> int:
     server = RendezvousServer(bind_addr="0.0.0.0")
     port = server.start()
     min_np = args.min_np or args.num_proc
+    # --start-timeout in elastic mode bounds slot assembly (reference:
+    # elastic settings use start_timeout for wait_for_available_slots).
+    driver_kwargs = {}
+    if getattr(args, "start_timeout", None):
+        driver_kwargs["timeout"] = args.start_timeout
     driver = ElasticDriver(
         server, HostManager(discovery), min_np=min_np, max_np=args.max_np,
-        reset_limit=args.reset_limit)
+        reset_limit=args.reset_limit, **driver_kwargs)
 
     from ..transport.tcp import _default_advertise_addr
 
@@ -59,9 +64,18 @@ def launch_elastic_job(args, command: List[str]) -> int:
     pumps: List[_OutputPump] = []
     lock = threading.Lock()
 
-    def create_worker(slot: SlotInfo, epoch: int) -> None:
+    def create_worker(slot: SlotInfo, epoch: int,
+                      host_slots: list = None) -> None:
+        # No per-chip binding in elastic mode: libtpu reads TPU_PROCESS_*
+        # once at process start, but elastic epochs respawn only NEW
+        # identities — survivors would keep a stale tiling and the slice
+        # could never re-form.  Elastic TPU jobs therefore run one process
+        # per host (the host's default libtpu ownership of all its chips),
+        # which also matches how preemption works: whole hosts come & go.
         env = _slot_env(slot, rdv_addr if not _is_local(slot.hostname)
-                        else "127.0.0.1", port, extra)
+                        else "127.0.0.1", port, extra,
+                        tpu_chip_binding=False,
+                        job_host_slots=host_slots)
         env["HOROVOD_EPOCH"] = str(epoch)
         cmd = command if _is_local(slot.hostname) \
             else _ssh_command(slot, command, env)

@@ -115,12 +115,22 @@ class LearningRateWarmupCallback(_keras_callback_base()):
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None):
     """Load a Keras model and rewrap its optimizer as distributed
-    (reference ``keras/__init__.py:143``)."""
+    (reference ``keras/__init__.py:143``).  ``custom_optimizers`` are
+    optimizer classes needed to deserialize the checkpoint, merged into
+    ``custom_objects`` by class name like the reference's
+    ``_keras.load_model`` does."""
     import keras
 
-    model = keras.models.load_model(filepath,
-                                    custom_objects=custom_objects)
-    model.optimizer = DistributedOptimizer(model.optimizer)
+    objects = dict(custom_objects or {})
+    for cls in custom_optimizers or []:
+        objects.setdefault(cls.__name__, cls)
+    model = keras.models.load_model(filepath, custom_objects=objects)
+    # In-place class swap, NOT DistributedOptimizer(): reconstructing via
+    # from_config would discard the checkpoint's restored slot variables
+    # (Adam moments) and iteration count.
+    from ..tensorflow import wrap_optimizer_instance
+
+    wrap_optimizer_instance(model.optimizer)
     return model
 
 

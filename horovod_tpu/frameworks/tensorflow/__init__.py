@@ -63,6 +63,38 @@ def _to_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+def _is_symbolic(tensor) -> bool:
+    """True for graph-mode tensors/variables (inside @tf.function), where
+    .numpy() does not exist and the collective must run through
+    tf.py_function."""
+    tf = _tf()
+    return (isinstance(tensor, (tf.Tensor, tf.Variable))
+            and not tf.executing_eagerly())
+
+
+def _graph_collective(kind: str, tensor, name: Optional[str], eager_fn,
+                      out_shape):
+    """Run ``eager_fn`` (a numpy-level collective) under ``tf.py_function``
+    so ``@tf.function`` graphs work (reference: the custom TF op runs in
+    graph mode natively, ``tensorflow/mpi_ops.cc:371-425``).
+
+    The wire name is fixed at trace time: graphs execute every step, and a
+    per-call auto-name would defeat the response cache and desync ranks
+    that trace different step counts.
+    """
+    tf = _tf()
+    tname = getattr(tensor, "name", None) or "t"
+    fixed = name or f"tf.graph.{kind}." + \
+        "".join(c if c.isalnum() or c in "._" else "_" for c in tname)
+
+    def _run(t):
+        return tf.convert_to_tensor(np.asarray(eager_fn(t.numpy(), fixed)))
+
+    out = tf.py_function(_run, [tensor], Tout=tensor.dtype)
+    out.set_shape(out_shape)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # collectives
 # ---------------------------------------------------------------------------
@@ -85,6 +117,14 @@ def allreduce(tensor, average: Optional[bool] = None,
             values = values / size()
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
+    if _is_symbolic(tensor):
+        return _graph_collective(
+            "allreduce", tensor, name,
+            lambda t, n: _core_ops.allreduce(
+                t, average=average, name=n, op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor),
+            out_shape=tensor.shape)
     out = _core_ops.allreduce(
         _to_numpy(tensor), average=average, name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor)
@@ -93,12 +133,22 @@ def allreduce(tensor, average: Optional[bool] = None,
 
 def allgather(tensor, name: Optional[str] = None):
     tf = _tf()
+    if _is_symbolic(tensor):
+        return _graph_collective(
+            "allgather", tensor, name,
+            lambda t, n: _core_ops.allgather(t, name=n),
+            out_shape=tf.TensorShape([None]).concatenate(tensor.shape[1:]))
     out = _core_ops.allgather(_to_numpy(tensor), name=name)
     return tf.convert_to_tensor(np.asarray(out))
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     tf = _tf()
+    if _is_symbolic(tensor):
+        return _graph_collective(
+            "broadcast", tensor, name,
+            lambda t, n: _core_ops.broadcast(t, root_rank, name=n),
+            out_shape=tensor.shape)
     out = _core_ops.broadcast(_to_numpy(tensor), root_rank, name=name)
     return tf.convert_to_tensor(np.asarray(out))
 
@@ -106,6 +156,11 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
 def alltoall(tensor, splits: Optional[List[int]] = None,
              name: Optional[str] = None):
     tf = _tf()
+    if _is_symbolic(tensor):
+        return _graph_collective(
+            "alltoall", tensor, name,
+            lambda t, n: _core_ops.alltoall(t, splits=splits, name=n),
+            out_shape=tf.TensorShape([None]).concatenate(tensor.shape[1:]))
     out = _core_ops.alltoall(_to_numpy(tensor), splits=splits, name=name)
     return tf.convert_to_tensor(np.asarray(out))
 
@@ -241,48 +296,85 @@ def DistributedOptimizer(optimizer, compression=None, op: str = Average,
     aggregation (reference ``gradient_aggregation.py``) with the allreduce
     firing every Nth step.
     """
-    comp = compression or Compression.none
-    bpps = max(1, backward_passes_per_step)
     base = optimizer.__class__
+    cls = _make_distributed_optimizer_class(
+        base, compression or Compression.none, op, backward_passes_per_step,
+        prescale_factor, postscale_factor)
+    if hasattr(optimizer, "get_config") and hasattr(base, "from_config"):
+        return cls.from_config(optimizer.get_config())
+    raise TypeError(
+        f"cannot wrap optimizer of type {base.__name__}: no "
+        f"get_config/from_config (reference requires a Keras optimizer)")
+
+
+def wrap_optimizer_instance(optimizer, compression=None, op: str = Average,
+                            backward_passes_per_step: int = 1,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0):
+    """Make a LIVE optimizer distributed in place (class swap), keeping all
+    its state — slot variables (Adam moments), iteration count, built
+    status.  Used by ``keras.load_model`` where reconstructing via
+    ``from_config`` would silently reset the restored optimizer state."""
+    optimizer.__class__ = _make_distributed_optimizer_class(
+        optimizer.__class__, compression or Compression.none, op,
+        backward_passes_per_step, prescale_factor, postscale_factor)
+    return optimizer
+
+
+def _make_distributed_optimizer_class(base, comp, op, backward_passes_per_step,
+                                      prescale_factor, postscale_factor):
+    bpps = max(1, backward_passes_per_step)
 
     class _DistributedKerasOptimizer(base):
         _hvd_agg = None
-        _hvd_counter = 0
+        _hvd_counter = None
 
         def apply_gradients(self, grads_and_vars, **kwargs):
             tf = _tf()
             grads_and_vars = list(grads_and_vars)
             grads = [g for g, _ in grads_and_vars]
             tvars = [v for _, v in grads_and_vars]
-            if bpps > 1:
-                if self._hvd_agg is None:
-                    self._hvd_agg = [
-                        tf.Variable(tf.zeros_like(g), trainable=False)
-                        if g is not None else None for g in grads]
-                for a, g in zip(self._hvd_agg, grads):
-                    if a is not None and g is not None:
-                        a.assign_add(g)
-                self._hvd_counter += 1
-                if self._hvd_counter < bpps:
-                    return None
-                grads = [a / bpps if a is not None else None
-                         for a in self._hvd_agg]
-            reduced = _allreduce_grads(grads, comp, op,
-                                       prescale_factor, postscale_factor)
-            result = super().apply_gradients(zip(reduced, tvars), **kwargs)
-            if bpps > 1:
+            if bpps == 1:
+                reduced = _allreduce_grads(grads, comp, op,
+                                           prescale_factor, postscale_factor)
+                return super().apply_gradients(zip(reduced, tvars), **kwargs)
+
+            # Local gradient aggregation (reference gradient_aggregation.py):
+            # graph-safe — the counter is a tf.Variable and the every-Nth
+            # sync is a tf.cond, because under model.fit the whole method is
+            # traced ONCE into a tf.function (a Python counter would bake
+            # the skip branch into the graph and never apply gradients).
+            if self._hvd_agg is None:  # first call/trace only
+                self._hvd_agg = [
+                    tf.Variable(tf.zeros_like(g), trainable=False)
+                    if g is not None else None for g in grads]
+                self._hvd_counter = tf.Variable(
+                    0, dtype=tf.int64, trainable=False)
+            for a, g in zip(self._hvd_agg, grads):
+                if a is not None and g is not None:
+                    a.assign_add(g)
+            self._hvd_counter.assign_add(1)
+            base_apply = super().apply_gradients
+
+            def _sync_and_apply():
+                agg = [a / bpps if a is not None else None
+                       for a in self._hvd_agg]
+                reduced = _allreduce_grads(agg, comp, op,
+                                           prescale_factor, postscale_factor)
+                base_apply(zip(reduced, tvars), **kwargs)
                 for a in self._hvd_agg:
                     if a is not None:
                         a.assign(tf.zeros_like(a))
-                self._hvd_counter = 0
-            return result
+                return tf.constant(True)
+
+            should = tf.equal(self._hvd_counter % bpps, 0)
+            if tf.executing_eagerly():
+                return _sync_and_apply() if bool(should) else None
+            return tf.cond(should, _sync_and_apply,
+                           lambda: tf.constant(False))
 
     _DistributedKerasOptimizer.__name__ = f"Distributed{base.__name__}"
-    if hasattr(optimizer, "get_config") and hasattr(base, "from_config"):
-        return _DistributedKerasOptimizer.from_config(optimizer.get_config())
-    raise TypeError(
-        f"cannot wrap optimizer of type {base.__name__}: no "
-        f"get_config/from_config (reference requires a Keras optimizer)")
+    return _DistributedKerasOptimizer
 
 
 __all__ = [

@@ -55,14 +55,27 @@ def _to_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+_INPLACE_TARGETS: Dict[int, Any] = {}
+
+
 def synchronize(handle: int):
     """Wait for an async op; returns a torch tensor (reference
-    ``mpi_ops.py:608-630``)."""
+    ``mpi_ops.py:608-630``).  Handles from the in-place flavors
+    (``allreduce_async_``/``broadcast_async_``) copy the result back into
+    the submitted tensor and return it, matching the reference where the
+    in-place op's output buffer *is* the input."""
     torch = _torch()
     out = _handles.wait(handle)
     if isinstance(out, tuple):  # alltoall returns (tensor, splits)
-        return torch.from_numpy(np.ascontiguousarray(np.asarray(out[0])))
-    return torch.from_numpy(np.ascontiguousarray(np.asarray(out)))
+        out = torch.from_numpy(np.ascontiguousarray(np.asarray(out[0])))
+    else:
+        out = torch.from_numpy(np.ascontiguousarray(np.asarray(out)))
+    target = _INPLACE_TARGETS.pop(handle, None)
+    if target is not None:
+        with torch.no_grad():
+            target.copy_(out.reshape(target.shape))
+        return target
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -103,20 +116,9 @@ def allreduce_(tensor, average: Optional[bool] = None,
                                          name=name, op=op))
 
 
-_INPLACE_TARGETS: Dict[int, Any] = {}
-
-
-def synchronize_(handle: int):
-    """Synchronize an in-place handle: copies the result into the submitted
-    tensor and returns it."""
-    torch = _torch()
-    out = synchronize(handle)
-    target = _INPLACE_TARGETS.pop(handle, None)
-    if target is not None:
-        with torch.no_grad():
-            target.copy_(out.reshape(target.shape))
-        return target
-    return out
+# Alias kept for callers that distinguish the in-place spelling; the
+# dispatch lives in synchronize() itself (keyed by handle).
+synchronize_ = synchronize
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> int:
@@ -286,12 +288,12 @@ class _DistributedOptimizer:
                     f"gradient for {name} allreduced twice before step(); "
                     "increase backward_passes_per_step for gradient "
                     "accumulation (reference optimizer.py:136-141)")
-            self._require_sync = True
             count = self._counters.get(name, 0) + 1
             self._counters[name] = count
             if count < self._bpps:
                 return
             self._counters[name] = 0
+            self._require_sync = True
             self._handles[name] = self._allreduce_grad_async(name, p)
         return hook
 
@@ -308,21 +310,29 @@ class _DistributedOptimizer:
         """Wait for all hooked allreduces and write back grads (reference
         ``optimizer.py:151-200``)."""
         torch = _torch()
-        missing = [(n, p) for n, p in self._named
-                   if n not in self._handles and self._counters.get(n, 0) == 0
-                   and p.grad is not None and self._require_sync]
-        # Params whose hook never fired this step (e.g. frozen branches)
-        # are skipped, like the reference's missing-handle path.
+        # Params whose hook never fired this step (e.g. a branch not taken
+        # on this rank) are submitted NOW: other ranks may have submitted
+        # them, and a one-sided wfbp.<name> would stall negotiation
+        # (reference optimizer.py:151-166 does the same).  A None grad
+        # (zero_grad(set_to_none=True) + branch not taken) contributes
+        # zeros; the accumulation counter resets so the param's
+        # backward_passes_per_step window stays aligned with the others.
+        for n, p in self._named:
+            if n not in self._handles:
+                if p.grad is None:
+                    p.grad = torch.zeros_like(p)
+                self._counters[n] = 0
+                self._handles[n] = self._allreduce_grad_async(n, p)
+        named = dict(self._named)
         for name, handle in list(self._handles.items()):
             out = synchronize(handle)
-            p = dict(self._named)[name]
+            p = named[name]
             ctx = getattr(self, "_ctx_for", {}).get(name)
             out = self._compression.decompress(out, ctx)
             with torch.no_grad():
                 p.grad.copy_(out.reshape(p.grad.shape).to(p.grad.dtype))
         self._handles.clear()
         self._require_sync = False
-        del missing
 
     def step(self, closure=None):
         if self._require_sync:

@@ -27,7 +27,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ..common import env as env_mod
-from . import config_parser
+from . import config_parser, tpu_topology
 from .hosts import SlotInfo, get_host_assignments, parse_host_files, parse_hosts
 from .rendezvous import RendezvousServer
 
@@ -45,7 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output-filename", default=None,
                    help="tee each rank's output into <dir>/rank.N/stdout|stderr")
     p.add_argument("--verbose", "-v", action="count", default=0)
-    p.add_argument("--start-timeout", type=int, default=120)
+    p.add_argument("--start-timeout", type=int, default=None,
+                   help="abort unless every worker reaches hvd.init() within "
+                        "this many seconds (default: wait forever — "
+                        "pre-init work like dataset download may legitimately "
+                        "take long)")
     p.add_argument("--config-file", default=None,
                    help="YAML file whose keys mirror the CLI flags")
     # runtime tunables (become HOROVOD_* env; reference launch.py:304-475)
@@ -65,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["trace", "debug", "info", "warning", "error"])
     p.add_argument("--mesh-axes", default=None,
                    help='TPU mesh axes, e.g. "dp:4,tp:2"')
+    p.add_argument("--no-tpu-chip-binding", action="store_true", default=False,
+                   help="don't export per-slot TPU_VISIBLE_CHIPS/"
+                        "TPU_PROCESS_* (default: exported on TPU VMs when "
+                        "a host runs more than one slot)")
     p.add_argument("--data-plane", default=None, choices=["xla", "tcp", "auto"])
     # elastic (wired by horovod_tpu.elastic)
     p.add_argument("--min-np", type=int, default=None)
@@ -77,7 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _slot_env(slot: SlotInfo, rdv_addr: str, rdv_port: int,
-              extra: Dict[str, str]) -> Dict[str, str]:
+              extra: Dict[str, str],
+              tpu_chip_binding: Optional[bool] = None,
+              job_host_slots: Optional[List] = None) -> Dict[str, str]:
     env = os.environ.copy()
     env.update(slot.to_env())
     env.update({
@@ -85,6 +95,22 @@ def _slot_env(slot: SlotInfo, rdv_addr: str, rdv_port: int,
         env_mod.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
         env_mod.HOROVOD_CONTROLLER: "tcp",
     })
+    if tpu_chip_binding is None:
+        # Auto-decide so every launch path (static, elastic, programmatic
+        # run()) binds consistently; only the static CLI exposes an opt-out.
+        # The decision is job-global (ANY host multi-slot → every slot
+        # binds): a single-slot host must still join the slice-wide
+        # process tiling the other ranks' TPU_PROCESS_ADDRESSES count.
+        multi = (any(n > 1 for _, n in job_host_slots)
+                 if job_host_slots else slot.local_size > 1)
+        tpu_chip_binding = tpu_topology.running_on_tpu_vm() and multi
+    if tpu_chip_binding:
+        # One process per chip (reference role: per-slot CUDA_VISIBLE_DEVICES
+        # construction in gloo_run.py:65-76; here libtpu needs the full
+        # TPU_PROCESS_* tiling, see tpu_topology.slot_tpu_env).
+        env.update(tpu_topology.slot_tpu_env(
+            slot.rank, slot.local_rank,
+            job_host_slots or [("localhost", slot.local_size)]))
     env.update(extra)
     # Make horovod_tpu importable in workers regardless of their cwd /
     # script location (the reference relies on pip-installation instead).
@@ -94,6 +120,20 @@ def _slot_env(slot: SlotInfo, rdv_addr: str, rdv_port: int,
     if pkg_parent not in parts:
         env["PYTHONPATH"] = os.pathsep.join([pkg_parent] + [p for p in parts if p])
     return env
+
+
+def host_slots_of(slots: List[SlotInfo]) -> List:
+    """Ordered (hostname, n_slots) pairs of a job's slot list — the
+    slice-wide shape every rank must agree on for TPU process tiling."""
+    out: List = []
+    for s in slots:
+        if out and out[-1][0] == s.hostname:
+            out[-1] = (s.hostname, out[-1][1] + 1)
+        elif any(h == s.hostname for h, _ in out):
+            raise ValueError("slot list not host-contiguous")
+        else:
+            out.append((s.hostname, 1))
+    return out
 
 
 def _is_local(hostname: str) -> bool:
@@ -106,9 +146,15 @@ def _ssh_command(slot: SlotInfo, command: List[str],
                  env: Dict[str, str]) -> List[str]:
     """Remote slot: carry HOROVOD_*/PYTHON* env through ssh explicitly
     (reference ``gloo_run.py:133-183`` builds the same kind of line)."""
+    # Forward only keys WE set for this slot: HOROVOD_* plus the per-slot
+    # chip-binding keys from slot_tpu_env.  Never blanket-forward ambient
+    # TPU_*/JAX_* from the launcher VM — e.g. its own TPU_WORKER_ID=0
+    # would clobber every remote host's identity and break slice init.
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in env.items()
-        if k.startswith("HOROVOD_") or k in ("PYTHONPATH", "PATH"))
+        if k.startswith("HOROVOD_")
+        or k in ("PYTHONPATH", "PATH")
+        or k in tpu_topology.SLOT_ENV_KEYS)
     remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
         " ".join(shlex.quote(c) for c in command)
     return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote]
@@ -167,8 +213,16 @@ def launch_job(args, command: List[str]) -> int:
     if args.hostfile:
         hosts_str = parse_host_files(args.hostfile)
     if not hosts_str:
-        hosts_str = f"localhost:{args.num_proc}"
+        # On a TPU pod-slice VM the runtime env describes the slice; an
+        # explicit -H always wins (reference: the launcher's host list is
+        # user-supplied; TPU slices are self-describing).
+        hosts_str = tpu_topology.discover() or f"localhost:{args.num_proc}"
+        if args.verbose and "," in hosts_str:
+            print(f"hvdrun: discovered TPU slice hosts: {hosts_str}",
+                  file=sys.stderr)
     slots = get_host_assignments(parse_hosts(hosts_str), args.num_proc)
+    tpu_chip_binding = False if args.no_tpu_chip_binding else None
+    job_host_slots = host_slots_of(slots)
 
     server = RendezvousServer(bind_addr="0.0.0.0")
     port = server.start()
@@ -197,7 +251,9 @@ def launch_job(args, command: List[str]) -> int:
     pumps: List[_OutputPump] = []
     try:
         for slot in slots:
-            env = _slot_env(slot, rdv_addr, port, extra)
+            env = _slot_env(slot, rdv_addr, port, extra,
+                            tpu_chip_binding=tpu_chip_binding,
+                            job_host_slots=job_host_slots)
             if _is_local(slot.hostname):
                 cmd = command
             else:
@@ -224,6 +280,14 @@ def launch_job(args, command: List[str]) -> int:
         exit_code: Optional[int] = None
         import time as _time
 
+        # --start-timeout (reference launch.py/--start-timeout): every
+        # worker marks itself in the rendezvous store when its transport
+        # comes up; abort the job if any rank hasn't by the deadline.
+        # Single-worker jobs skip the store entirely, so exempt np=1.
+        start_deadline = (_time.monotonic() + args.start_timeout
+                          if args.start_timeout and len(slots) > 1 else None)
+        unstarted = {s.rank for s in slots} if start_deadline else set()
+
         while True:
             codes = [p.poll() for p in procs]
             failed = [c for c in codes if c not in (None, 0)]
@@ -234,6 +298,17 @@ def launch_job(args, command: List[str]) -> int:
                 for p in procs:
                     if p.poll() is None:
                         p.send_signal(signal.SIGTERM)
+            if unstarted and exit_code is None:
+                unstarted = {r for r in unstarted
+                             if server.get("worker_started", str(r)) is None}
+                if unstarted and _time.monotonic() > start_deadline:
+                    print(f"hvdrun: ranks {sorted(unstarted)} failed to start "
+                          f"within --start-timeout={args.start_timeout}s; "
+                          "aborting", file=sys.stderr)
+                    exit_code = 1
+                    for p in procs:
+                        if p.poll() is None:
+                            p.send_signal(signal.SIGTERM)
             if all(c is not None for c in codes):
                 if exit_code is None:
                     exit_code = 0

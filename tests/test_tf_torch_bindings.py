@@ -166,3 +166,86 @@ print("TINPLACE_OK", rank, flush=True)
 """, timeout=240)
     for r, o in enumerate(out):
         assert f"TINPLACE_OK {r}" in o
+
+
+def test_torch_public_synchronize_honors_inplace():
+    """synchronize(h) on an in-place handle must mutate the submitted
+    tensor (reference mpi_ops.py: in-place op's output buffer IS the
+    input) and drop the target-table entry."""
+    out = run_distributed(2, """
+import torch
+import horovod_tpu.torch as ht
+
+t = torch.ones(3) * (rank + 1)
+h = ht.allreduce_async_(t, op=ht.Sum, name="ip2")
+res = ht.synchronize(h)          # public, non-underscore spelling
+assert np.allclose(t.numpy(), 3.0), t
+assert res is t
+from horovod_tpu.frameworks.torch import _INPLACE_TARGETS
+assert not _INPLACE_TARGETS, _INPLACE_TARGETS
+print("TSYNC_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TSYNC_OK {r}" in o
+
+
+def test_tf_graph_mode_collectives():
+    """Collectives inside @tf.function (symbolic tensors) run via
+    tf.py_function (reference: graph mode via the custom op,
+    mpi_ops.cc:371-425)."""
+    out = run_distributed(2, """
+import os
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = "2"
+import tensorflow as tf
+import horovod_tpu.tensorflow as htf
+
+@tf.function
+def step(x):
+    return htf.allreduce(x, op=htf.Sum, name="g1")
+
+for i in range(3):  # repeated executions reuse the traced wire name
+    o = step(tf.constant([1.0, 2.0]) * (rank + 1) * (i + 1))
+    assert np.allclose(o.numpy(), np.array([3.0, 6.0]) * (i + 1)), o
+
+@tf.function
+def gstep(x):
+    return htf.allgather(x, name="g2"), htf.broadcast(x, 0, name="g3")
+
+g, b = gstep(tf.constant([[float(rank)]]))
+assert g.shape == (2, 1) and np.allclose(g.numpy().ravel(), [0.0, 1.0])
+assert np.allclose(b.numpy(), 0.0)
+print("TFGRAPH_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TFGRAPH_OK {r}" in o
+
+
+def test_tf_optimizer_bpps_graph_mode():
+    """backward_passes_per_step accumulation must work when apply_gradients
+    is traced into a tf.function (model.fit default): a Python counter
+    would bake the skip-branch into the graph and never update weights."""
+    out = run_distributed(2, """
+import os
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = "2"
+import tensorflow as tf
+import horovod_tpu.tensorflow as htf
+
+opt = htf.DistributedOptimizer(
+    tf.keras.optimizers.SGD(learning_rate=1.0), backward_passes_per_step=2)
+v = tf.Variable([10.0])
+
+@tf.function
+def apply(g):
+    opt.apply_gradients([(g, v)])
+
+apply(tf.constant([float(rank + 1)]))      # pass 1: accumulate only
+assert np.allclose(v.numpy(), 10.0), v.numpy()
+apply(tf.constant([float(rank + 1)]))      # pass 2: allreduce + apply
+# grad = mean_r(2*(r+1)/2) = mean(1,2) = 1.5 ; v = 10 - 1.5
+assert np.allclose(v.numpy(), 8.5), v.numpy()
+apply(tf.constant([1.0]))                  # next window accumulates again
+assert np.allclose(v.numpy(), 8.5), v.numpy()
+print("TFBPPS_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TFBPPS_OK {r}" in o
