@@ -80,8 +80,7 @@ class ElasticDriver:
         self._transient_failures: Dict[str, int] = defaultdict(int)
         self._slots: List[SlotInfo] = []
         self._known_identities: Dict[str, SlotInfo] = {}
-        self._create_worker: \
-            Optional[Callable[[SlotInfo, int, list], None]] = None
+        self._create_worker: Optional[Callable[[SlotInfo, int], None]] = None
         self._registry = WorkerStateRegistry(0)
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
@@ -113,8 +112,7 @@ class ElasticDriver:
                     f"(have {self.hosts.total_slots()})")
             time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
 
-    def start(self,
-              create_worker: Callable[[SlotInfo, int, list], None]) -> None:
+    def start(self, create_worker: Callable[[SlotInfo, int], None]) -> None:
         """Publish epoch 0 assignments, spawn workers, start discovery."""
         self._create_worker = create_worker
         self.wait_for_available_slots()
@@ -149,11 +147,6 @@ class ElasticDriver:
             new_slots = self._assignments()
             self._slots = new_slots
             self._registry.reset(len(new_slots))
-            # This epoch's slice shape, for TPU process tiling of workers
-            # spawned below (launch.host_slots_of shape).
-            from ..runner.launch import host_slots_of
-
-            epoch_host_slots = host_slots_of(new_slots)
 
             # Publish the new table; removed identities get rank -1 so a
             # surviving process on a removed host exits cleanly.
@@ -188,7 +181,7 @@ class ElasticDriver:
                 if identity not in self._known_identities:
                     log.info("spawning worker %s (epoch %d, rank %d)",
                              identity, self.epoch, s.rank)
-                    self._create_worker(s, self.epoch, epoch_host_slots)
+                    self._create_worker(s, self.epoch)
                     self._exited_identities.discard(identity)
                     self.rendezvous.set("epoch_ack", identity,
                                         str(self.epoch).encode())

@@ -40,7 +40,14 @@ def launch_elastic_job(args, command: List[str]) -> int:
             hosts_str = f"localhost:{args.num_proc}"
         discovery = FixedHosts(parse_hosts(hosts_str))
 
-    server = RendezvousServer(bind_addr="0.0.0.0")
+    from ..common import secret as secret_mod
+
+    job_secret = (os.environ.get(env_mod.HOROVOD_SECRET_KEY)
+                  or secret_mod.make_secret())
+    os.environ[env_mod.HOROVOD_SECRET_KEY] = job_secret
+
+    server = RendezvousServer(bind_addr="0.0.0.0",
+                              job_secret=job_secret.encode())
     port = server.start()
     min_np = args.min_np or args.num_proc
     # --start-timeout in elastic mode bounds slot assembly (reference:
@@ -64,8 +71,7 @@ def launch_elastic_job(args, command: List[str]) -> int:
     pumps: List[_OutputPump] = []
     lock = threading.Lock()
 
-    def create_worker(slot: SlotInfo, epoch: int,
-                      host_slots: list = None) -> None:
+    def create_worker(slot: SlotInfo, epoch: int) -> None:
         # No per-chip binding in elastic mode: libtpu reads TPU_PROCESS_*
         # once at process start, but elastic epochs respawn only NEW
         # identities — survivors would keep a stale tiling and the slice
@@ -74,14 +80,18 @@ def launch_elastic_job(args, command: List[str]) -> int:
         # which also matches how preemption works: whole hosts come & go.
         env = _slot_env(slot, rdv_addr if not _is_local(slot.hostname)
                         else "127.0.0.1", port, extra,
-                        tpu_chip_binding=False,
-                        job_host_slots=host_slots)
+                        tpu_chip_binding=False)
         env["HOROVOD_EPOCH"] = str(epoch)
-        cmd = command if _is_local(slot.hostname) \
-            else _ssh_command(slot, command, env)
+        local = _is_local(slot.hostname)
+        cmd = command if local else _ssh_command(slot, command, env)
         proc = subprocess.Popen(cmd, env=env, text=True,
                                 stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE)
+                                stderr=subprocess.PIPE,
+                                stdin=None if local else subprocess.PIPE)
+        if not local:  # HMAC key over stdin (see _ssh_command)
+            proc.stdin.write(env[env_mod.HOROVOD_SECRET_KEY] + "\n")
+            proc.stdin.flush()
+            proc.stdin.close()
         identity = f"{slot.hostname}:{slot.local_rank}"
         with lock:
             procs[identity] = proc

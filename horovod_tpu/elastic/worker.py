@@ -32,7 +32,15 @@ class _NotifyHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         from urllib.parse import parse_qs, urlparse
 
+        from ..common import secret as secret_mod
         from .state import notify_hosts_updated
+
+        secret = secret_mod.job_secret()
+        if secret is not None and not secret_mod.verify(
+                secret, self.command, self.path, b"",
+                self.headers.get(secret_mod.SIG_HEADER)):
+            self.send_error(403, "bad or missing request signature")
+            return
 
         parsed = urlparse(self.path)
         added_only = parsed.path.rstrip("/").endswith("added")
@@ -78,11 +86,17 @@ class WorkerNotificationClient:
                              epoch: Optional[int] = None) -> None:
         suffix = "added" if added_only else "changed"
         query = f"?epoch={epoch}" if epoch is not None else ""
+        from ..common import secret as secret_mod
+
+        secret = secret_mod.job_secret()
         for addr in self._addresses:
             try:
+                path = f"/notify/{suffix}{query}"
                 req = urllib.request.Request(
-                    f"http://{addr}/notify/{suffix}{query}",
-                    data=b"", method="POST")
+                    f"http://{addr}{path}", data=b"", method="POST")
+                if secret is not None:
+                    req.add_header(secret_mod.SIG_HEADER,
+                                   secret_mod.sign(secret, "POST", path, b""))
                 with urllib.request.urlopen(req, timeout=5):
                     pass
             except OSError as e:

@@ -297,8 +297,9 @@ class _DistributedOptimizer:
             self._handles[name] = self._allreduce_grad_async(name, p)
         return hook
 
-    def _allreduce_grad_async(self, name: str, p) -> int:
-        comp, ctx = self._compression.compress(p.grad)
+    def _allreduce_grad_async(self, name: str, p, grad=None) -> int:
+        comp, ctx = self._compression.compress(
+            grad if grad is not None else p.grad)
         handle = allreduce_async(
             comp, op=self._op, name=f"wfbp.{name}",
             postscale_factor=1.0 / self._bpps)
@@ -315,20 +316,23 @@ class _DistributedOptimizer:
         # them, and a one-sided wfbp.<name> would stall negotiation
         # (reference optimizer.py:151-166 does the same).  A None grad
         # (zero_grad(set_to_none=True) + branch not taken) contributes
-        # zeros; the accumulation counter resets so the param's
-        # backward_passes_per_step window stays aligned with the others.
+        # zeros WITHOUT materializing p.grad — otherwise the base
+        # optimizer's weight decay/momentum would start mutating params
+        # torch would have skipped; the accumulation counter resets so the
+        # param's backward_passes_per_step window stays aligned.
         for n, p in self._named:
             if n not in self._handles:
-                if p.grad is None:
-                    p.grad = torch.zeros_like(p)
                 self._counters[n] = 0
-                self._handles[n] = self._allreduce_grad_async(n, p)
+                grad = p.grad if p.grad is not None else torch.zeros_like(p)
+                self._handles[n] = self._allreduce_grad_async(n, p, grad)
         named = dict(self._named)
         for name, handle in list(self._handles.items()):
             out = synchronize(handle)
             p = named[name]
             ctx = getattr(self, "_ctx_for", {}).get(name)
             out = self._compression.decompress(out, ctx)
+            if p.grad is None:
+                continue  # zero-substituted: keep torch's skip semantics
             with torch.no_grad():
                 p.grad.copy_(out.reshape(p.grad.shape).to(p.grad.dtype))
         self._handles.clear()

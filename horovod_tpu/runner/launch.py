@@ -150,12 +150,15 @@ def _ssh_command(slot: SlotInfo, command: List[str],
     # chip-binding keys from slot_tpu_env.  Never blanket-forward ambient
     # TPU_*/JAX_* from the launcher VM — e.g. its own TPU_WORKER_ID=0
     # would clobber every remote host's identity and break slice init.
+    # The job's HMAC key travels over ssh STDIN, not the command line —
+    # argv is world-readable via /proc on every host it touches.
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in env.items()
-        if k.startswith("HOROVOD_")
+        if (k.startswith("HOROVOD_") and k != env_mod.HOROVOD_SECRET_KEY)
         or k in ("PYTHONPATH", "PATH")
         or k in tpu_topology.SLOT_ENV_KEYS)
-    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+    remote = "IFS= read -r HOROVOD_SECRET_KEY && export HOROVOD_SECRET_KEY" \
+        f" && cd {shlex.quote(os.getcwd())} && env {exports} " + \
         " ".join(shlex.quote(c) for c in command)
     return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote]
 
@@ -224,7 +227,17 @@ def launch_job(args, command: List[str]) -> int:
     tpu_chip_binding = False if args.no_tpu_chip_binding else None
     job_host_slots = host_slots_of(slots)
 
-    server = RendezvousServer(bind_addr="0.0.0.0")
+    # Per-job HMAC key for every service-plane RPC (reference secret.py:36);
+    # exported into our own env too so in-process clients (driver,
+    # notification) sign consistently.
+    from ..common import secret as secret_mod
+
+    job_secret = (os.environ.get(env_mod.HOROVOD_SECRET_KEY)
+                  or secret_mod.make_secret())
+    os.environ[env_mod.HOROVOD_SECRET_KEY] = job_secret
+
+    server = RendezvousServer(bind_addr="0.0.0.0",
+                              job_secret=job_secret.encode())
     port = server.start()
     server.publish_slots([{
         "hostname": s.hostname, "rank": s.rank, "local_rank": s.local_rank,
@@ -254,13 +267,16 @@ def launch_job(args, command: List[str]) -> int:
             env = _slot_env(slot, rdv_addr, port, extra,
                             tpu_chip_binding=tpu_chip_binding,
                             job_host_slots=job_host_slots)
-            if _is_local(slot.hostname):
-                cmd = command
-            else:
-                cmd = _ssh_command(slot, command, env)
+            local = _is_local(slot.hostname)
+            cmd = command if local else _ssh_command(slot, command, env)
             proc = subprocess.Popen(
                 cmd, env=env, text=True, stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE)
+                stderr=subprocess.PIPE,
+                stdin=None if local else subprocess.PIPE)
+            if not local:  # hand the HMAC key over stdin (see _ssh_command)
+                proc.stdin.write(env[env_mod.HOROVOD_SECRET_KEY] + "\n")
+                proc.stdin.flush()
+                proc.stdin.close()
             procs.append(proc)
             if args.output_filename:
                 rank_dir = os.path.join(args.output_filename,

@@ -34,6 +34,21 @@ class _Handler(BaseHTTPRequestHandler):
             return None
         return parts[0], parts[1]
 
+    def _authorized(self, body: bytes) -> bool:
+        """HMAC check (reference network.py:50-85): when the server holds a
+        job secret, every request must carry a valid signature — otherwise
+        any LAN peer could rewrite the rank table."""
+        secret = self.server.job_secret
+        if secret is None:
+            return True
+        from ..common import secret as secret_mod
+
+        ok = secret_mod.verify(secret, self.command, self.path, body,
+                               self.headers.get(secret_mod.SIG_HEADER))
+        if not ok:
+            self.send_error(403, "bad or missing request signature")
+        return ok
+
     def do_PUT(self):
         parsed = self._parse()
         if parsed is None:
@@ -41,6 +56,8 @@ class _Handler(BaseHTTPRequestHandler):
         scope, key = parsed
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if not self._authorized(body):
+            return
         self.server.store_set(scope, key, body)
         self.send_response(200)
         self.send_header("Content-Length", "0")
@@ -51,6 +68,8 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed is None:
             return
         scope, key = parsed
+        if not self._authorized(b""):
+            return
         val = self.server.store_get(scope, key)
         if val is None:
             self.send_error(404, "no such key")
@@ -65,6 +84,8 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed is None:
             return
         scope, key = parsed
+        if not self._authorized(b""):
+            return
         existed = self.server.store_delete(scope, key)
         self.send_response(200 if existed else 404)
         self.send_header("Content-Length", "0")
@@ -75,12 +96,13 @@ class _KVServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, addr, delete_hook=None):
+    def __init__(self, addr, delete_hook=None, job_secret=None):
         super().__init__(addr, _Handler)
         # Compose the canonical MemoryStore so storage semantics (keying,
         # locking) live in exactly one place (transport/store.py).
         self._store = MemoryStore()
         self._delete_hook = delete_hook
+        self.job_secret = job_secret
 
     def store_set(self, scope: str, key: str, value: bytes) -> None:
         self._store.set(scope, key, value)
@@ -100,14 +122,17 @@ class RendezvousServer:
     """Launcher-side KV server; start() returns the bound port."""
 
     def __init__(self, bind_addr: str = "0.0.0.0",
-                 delete_hook: Optional[Callable[[str, str], None]] = None):
+                 delete_hook: Optional[Callable[[str, str], None]] = None,
+                 job_secret: Optional[bytes] = None):
         self._bind_addr = bind_addr
         self._server: Optional[_KVServer] = None
         self._thread: Optional[threading.Thread] = None
         self._delete_hook = delete_hook
+        self._job_secret = job_secret
 
     def start(self, port: int = 0) -> int:
-        self._server = _KVServer((self._bind_addr, port), self._delete_hook)
+        self._server = _KVServer((self._bind_addr, port), self._delete_hook,
+                                 job_secret=self._job_secret)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="rendezvous-http", daemon=True)
         self._thread.start()

@@ -95,17 +95,34 @@ class HTTPStoreClient(Store):
     def __init__(self, addr: str, port: int, timeout: float = 30.0):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
+        # Per-job HMAC key (common/secret.py); None = unsigned dev mode.
+        from ..common import secret as secret_mod
+
+        self._secret = secret_mod.job_secret()
 
     def _url(self, scope: str, key: str) -> str:
         return f"{self._base}/{urllib.parse.quote(scope)}/{urllib.parse.quote(key)}"
 
+    def _request(self, scope: str, key: str, method: str,
+                 data: Optional[bytes] = None) -> urllib.request.Request:
+        url = self._url(scope, key)
+        req = urllib.request.Request(url, data=data, method=method)
+        if self._secret is not None:
+            from ..common import secret as secret_mod
+
+            path = url[len(self._base):]
+            req.add_header(secret_mod.SIG_HEADER,
+                           secret_mod.sign(self._secret, method, path,
+                                           data or b""))
+        return req
+
     def set(self, scope: str, key: str, value: bytes) -> None:
-        req = urllib.request.Request(self._url(scope, key), data=value, method="PUT")
+        req = self._request(scope, key, "PUT", value)
         with urllib.request.urlopen(req, timeout=self._timeout):
             pass
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        req = urllib.request.Request(self._url(scope, key), method="GET")
+        req = self._request(scope, key, "GET")
         try:
             with urllib.request.urlopen(req, timeout=self._timeout) as resp:
                 return resp.read()
@@ -115,7 +132,7 @@ class HTTPStoreClient(Store):
             raise
 
     def delete(self, scope: str, key: str) -> None:
-        req = urllib.request.Request(self._url(scope, key), method="DELETE")
+        req = self._request(scope, key, "DELETE")
         try:
             with urllib.request.urlopen(req, timeout=self._timeout):
                 pass

@@ -58,11 +58,22 @@ class TcpMesh:
             self._listener = None
             return
 
+        from ..common import secret as secret_mod
+
+        self._secret = secret_mod.job_secret()
         self._listener = socket.create_server((bind_addr, 0), backlog=size)
         port = self._listener.getsockname()[1]
-        if advertise_addr is None:
-            advertise_addr = _default_advertise_addr()
-        store.set(scope, str(rank), f"{advertise_addr}:{port}".encode())
+        if advertise_addr is not None:
+            candidates = [advertise_addr]
+        else:
+            # NIC negotiation, dial-side (reference role:
+            # driver_service.py:162-194 intersects routable interfaces by
+            # ssh-probing every host; here every rank advertises ALL its
+            # candidate addresses and dialers try them in order — same
+            # outcome on multi-homed hosts, no ssh dance).
+            candidates = candidate_advertise_addrs()
+        store.set(scope, str(rank),
+                  ",".join(f"{a}:{port}" for a in candidates).encode())
 
         # Accept connections from higher ranks while dialing lower ranks.
         accept_err: List[BaseException] = []
@@ -75,10 +86,12 @@ class TcpMesh:
         lower = [str(j) for j in range(rank)]
         addrs = store.wait(scope, lower, timeout=timeout) if lower else {}
         for j in range(rank):
-            host, p = addrs[str(j)].decode().rsplit(":", 1)
-            sock = _dial(host, int(p), timeout)
-            sock.sendall(_HELLO + struct.pack("<I", rank))
-            self._peers[j] = _Peer(sock)
+            endpoints = []
+            for spec in addrs[str(j)].decode().split(","):
+                host, p = spec.rsplit(":", 1)
+                endpoints.append((host, int(p)))
+            self._peers[j] = _Peer(
+                self._dial_peer(j, endpoints, timeout))
 
         acceptor.join(timeout=timeout)
         if accept_err:
@@ -87,18 +100,93 @@ class TcpMesh:
             raise HorovodInternalError(
                 f"tcp mesh incomplete: have {len(self._peers)}/{size - 1} peers")
 
+    # -- handshake ----------------------------------------------------------
+    #
+    # dialer:   HELLO + my_rank [+ HMAC]  →
+    # acceptor:                            ←  HELLO + its_rank [+ HMAC]
+    #
+    # The ack lets a dialer detect that a candidate address reached the
+    # wrong machine (multi-homed hosts) and fall through to the next one;
+    # the HMAC (when HOROVOD_SECRET_KEY is set) keeps arbitrary LAN peers
+    # out of the data fabric (reference network.py:50-85 role).
+
+    def _hello_blob(self, rank: int) -> bytes:
+        blob = _HELLO + struct.pack("<I", rank)
+        if self._secret is not None:
+            from ..common import secret as secret_mod
+
+            blob += secret_mod.sign_blob(self._secret, blob)
+        return blob
+
+    def _check_hello(self, data: bytes) -> int:
+        """Validate magic+sig; returns the peer rank or raises."""
+        if data[:4] != _HELLO:
+            raise HorovodInternalError("bad tcp mesh hello")
+        if self._secret is not None:
+            from ..common import secret as secret_mod
+
+            if not secret_mod.verify_blob(self._secret, data[:8], data[8:]):
+                raise HorovodInternalError("tcp mesh hello failed HMAC check")
+        return struct.unpack("<I", data[4:8])[0]
+
+    def _hello_len(self) -> int:
+        return 8 + (32 if self._secret is not None else 0)
+
+    def _dial_peer(self, target: int, endpoints: List,
+                   timeout: float) -> socket.socket:
+        deadline = time.monotonic() + timeout
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            for host, port in endpoints:
+                try:
+                    sock = socket.create_connection(
+                        (host, port), timeout=min(5.0, timeout))
+                    _configure(sock)
+                    # Bounded handshake: an endpoint that accepts but never
+                    # answers must fall through to the next candidate, not
+                    # hang the mesh (symmetric with the accept side).
+                    sock.settimeout(5.0)
+                    sock.sendall(self._hello_blob(self.rank))
+                    got = self._check_hello(
+                        _recv_exact(sock, self._hello_len()))
+                    if got != target:
+                        sock.close()
+                        raise HorovodInternalError(
+                            f"{host}:{port} answered as rank {got}")
+                    sock.settimeout(None)
+                    return sock
+                except (OSError, HorovodInternalError) as e:
+                    last = e
+            time.sleep(0.05)
+        raise HorovodInternalError(
+            f"could not connect to rank {target} at {endpoints}: {last}")
+
     def _accept_loop(self, n_expected: int, err: List[BaseException],
                      timeout: float) -> None:
         try:
-            self._listener.settimeout(timeout)
-            for _ in range(n_expected):
+            deadline = time.monotonic() + timeout
+            registered = 0
+            while registered < n_expected:
+                self._listener.settimeout(
+                    max(0.1, deadline - time.monotonic()))
                 sock, _ = self._listener.accept()
-                _configure(sock)
-                hello = _recv_exact(sock, 8)
-                if hello[:4] != _HELLO:
-                    raise HorovodInternalError("bad tcp mesh hello")
-                peer_rank = struct.unpack("<I", hello[4:])[0]
-                self._peers[peer_rank] = _Peer(sock)
+                try:
+                    _configure(sock)
+                    sock.settimeout(5.0)
+                    peer_rank = self._check_hello(
+                        _recv_exact(sock, self._hello_len()))
+                    sock.sendall(self._hello_blob(self.rank))
+                    sock.settimeout(None)
+                except (OSError, HorovodInternalError):
+                    # Unauthenticated or misrouted connection: drop it
+                    # without counting toward the expected peer set.
+                    sock.close()
+                    continue
+                if peer_rank not in self._peers:
+                    self._peers[peer_rank] = _Peer(sock)
+                    registered += 1
+                else:
+                    sock.close()
         except BaseException as e:  # surfaced by constructor
             err.append(e)
 
@@ -197,18 +285,31 @@ def _default_advertise_addr() -> str:
         return "127.0.0.1"
 
 
-def _dial(host: str, port: int, timeout: float) -> socket.socket:
-    deadline = time.monotonic() + timeout
-    last: Optional[Exception] = None
-    while time.monotonic() < deadline:
-        try:
-            sock = socket.create_connection((host, port), timeout=timeout)
-            _configure(sock)
-            return sock
-        except OSError as e:
-            last = e
-            time.sleep(0.05)
-    raise HorovodInternalError(f"could not connect to {host}:{port}: {last}")
+def candidate_advertise_addrs() -> List[str]:
+    """All plausible addresses of this host, best first.
+
+    Multi-host jobs (HOROVOD_CROSS_SIZE > 1) exclude loopback: a remote
+    peer dialing 127.0.0.1 would reach itself.  Single-host jobs put
+    loopback first — always right and fastest.
+    """
+    from ..common import env as env_mod
+
+    multi_host = env_mod.get_int(env_mod.HOROVOD_CROSS_SIZE, 1) > 1
+    addrs: List[str] = []
+    primary = _default_advertise_addr()
+    if primary != "127.0.0.1":
+        addrs.append(primary)
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None,
+                                       socket.AF_INET):
+            a = info[4][0]
+            if a not in addrs and not a.startswith("127."):
+                addrs.append(a)
+    except OSError:
+        pass
+    if multi_host:
+        return addrs or [primary]
+    return ["127.0.0.1"] + addrs
 
 
 def _configure(sock: socket.socket) -> None:

@@ -249,3 +249,29 @@ print("TFBPPS_OK", rank, flush=True)
 """, timeout=240)
     for r, o in enumerate(out):
         assert f"TFBPPS_OK {r}" in o
+
+
+def test_torch_unused_param_keeps_none_grad():
+    """A param whose hook never fired and whose grad is None must be
+    zero-substituted on the WIRE only: p.grad stays None so the base
+    optimizer's weight decay/momentum keeps skipping it."""
+    out = run_distributed(2, """
+import torch
+import horovod_tpu.torch as ht
+
+a = torch.nn.Parameter(torch.ones(2))
+b = torch.nn.Parameter(torch.full((2,), 5.0))
+opt = ht.DistributedOptimizer(
+    torch.optim.SGD([a, b], lr=0.1, weight_decay=0.5),
+    named_parameters=[("a", a), ("b", b)])
+loss = (a * (rank + 1)).sum()   # b unused
+loss.backward()
+opt.step()
+assert b.grad is None, b.grad
+assert np.allclose(b.detach().numpy(), 5.0), b   # no decay drift
+exp = 1.0 - 0.1 * (1.5 + 0.5)   # mean grad 1.5 + wd*1.0
+assert np.allclose(a.detach().numpy(), exp), (a, exp)
+print("TUNUSED_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TUNUSED_OK {r}" in o

@@ -161,3 +161,113 @@ def test_tcp_mesh_over_http_store():
         assert all(run_ranks(2, fn))
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# service-plane security (reference network.py:50-85, secret.py:36)
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_rejects_unsigned_requests(monkeypatch):
+    """A server holding a job secret must 403 unsigned/missigned traffic —
+    otherwise any LAN peer can rewrite the rank table."""
+    import urllib.error
+    import urllib.request
+
+    from horovod_tpu.common import env as env_mod
+
+    server = RendezvousServer(bind_addr="127.0.0.1", job_secret=b"k" * 32)
+    port = server.start()
+    try:
+        # unsigned PUT → 403
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/s/a", data=b"evil", method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 403
+        # signed client (secret via env) → accepted
+        monkeypatch.setenv(env_mod.HOROVOD_SECRET_KEY, "k" * 32)
+        good = HTTPStoreClient("127.0.0.1", port)
+        good.set("s", "a", b"ok")
+        assert good.get("s", "a") == b"ok"
+        # client with the WRONG key → 403 on read too
+        monkeypatch.setenv(env_mod.HOROVOD_SECRET_KEY, "x" * 32)
+        bad = HTTPStoreClient("127.0.0.1", port)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            bad.get("s", "a")
+        assert ei.value.code == 403
+    finally:
+        server.stop()
+
+
+def test_tcp_mesh_authenticated_hello(monkeypatch):
+    """With a job secret, mesh peers HMAC their hellos; an interloper
+    without the key cannot join (its connection is dropped, the real mesh
+    still forms)."""
+    import socket as socket_mod
+
+    from horovod_tpu.common import env as env_mod
+
+    monkeypatch.setenv(env_mod.HOROVOD_SECRET_KEY, "s" * 32)
+    store = MemoryStore()
+
+    def make(rank):
+        return TcpMesh(rank, 2, store, scope="auth")
+
+    def attack():
+        # wait for rank 1's advertised endpoint, connect with a bogus hello
+        try:
+            import time
+            deadline = time.monotonic() + 5
+            val = None
+            while val is None and time.monotonic() < deadline:
+                val = store.get("auth", "1")
+                time.sleep(0.01)
+            host, port = val.decode().split(",")[0].rsplit(":", 1)
+            s = socket_mod.create_connection((host, int(port)), timeout=5)
+            s.sendall(b"HVMT\x00\x00\x00\x00" + b"\x00" * 32)  # bad sig
+        except OSError:
+            pass  # mesh dropping us mid-write is the expected outcome
+
+    threading.Thread(target=attack, daemon=True).start()
+    meshes = run_ranks(2, make)
+    meshes[0].send(1, b"payload")
+    assert meshes[1].recv(0) == b"payload"
+    for m in meshes:
+        m.close()
+
+
+def test_tcp_mesh_multi_addr_fallback():
+    """Dialers fall through dead advertised addresses to a live one
+    (NIC-negotiation role, reference driver_service.py:162-194).  The
+    dialing rank sees rank 0's advertisement with an unroutable first
+    entry — as a multi-homed host with a dead NIC would publish."""
+    store = MemoryStore()
+
+    class DeadFirstStore(MemoryStore):
+        """Rank 1's view: rank 0 advertises a dead endpoint first."""
+
+        def get(self, scope, key):
+            val = store.get(scope, key)
+            if val is not None and scope == "nic" and key == "0":
+                # 203.0.113.0/24 is TEST-NET-3: guaranteed unroutable.
+                return b"203.0.113.1:59999," + val
+            return val
+
+        def set(self, scope, key, value):
+            store.set(scope, key, value)
+
+    dead_first = DeadFirstStore()
+
+    def make(rank):
+        if rank == 0:
+            return TcpMesh(0, 2, store, scope="nic",
+                           advertise_addr="127.0.0.1")
+        return TcpMesh(1, 2, dead_first, scope="nic",
+                       advertise_addr="127.0.0.1")
+
+    res = run_ranks(2, make, timeout=60)
+    res[1].send(0, b"hi")
+    assert res[0].recv(1) == b"hi"
+    for m in res:
+        m.close()
