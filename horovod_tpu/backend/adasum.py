@@ -137,7 +137,8 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
                 f"(reference adasum.h has the same restriction)")
 
         acc_dtype = cpu_ring._accum_dtype(entries[0].tensor.dtype)
-        buf = cpu_ring.fuse_entries(entries, acc_dtype)
+        staged = len(entries) > 1 and self.fusion_buffers is not None
+        buf = cpu_ring.fuse_entries(entries, acc_dtype, self.fusion_buffers)
         if response.prescale_factor != 1.0:
             buf *= response.prescale_factor
         sizes = [int(np.prod(e.tensor.shape)) if e.tensor.shape else 1
@@ -208,7 +209,8 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
         if response.postscale_factor != 1.0:
             buf = buf * response.postscale_factor
         cpu_ring.unfuse_entries(
-            buf.astype(response.tensor_type.to_numpy(), copy=False), entries)
+            buf.astype(response.tensor_type.to_numpy(), copy=False), entries,
+            copy=staged)
         return Status.OK()
 
 

@@ -7,9 +7,16 @@ run the collective, scatter results back out.  Algorithms:
 
 - allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
   2·(N−1) steps — same family as NCCL's ring; ``gloo::allreduce`` ring).
+  Low-precision floats travel NARROW on the wire and widen only inside
+  each reduction step (reference ``half.cc`` custom MPI fp16 sum).
+- hierarchical allreduce: intra-host reduce-scatter → cross-host ring on
+  each rank's chunk → intra-host allgather, so each local rank carries
+  1/local_size of the cross-host traffic in parallel (reference
+  ``NCCLHierarchicalAllreduce``, ``nccl_operations.cc:194-405``).
 - allgather(v): ring pipeline, N−1 steps of neighbor forwarding.
-- broadcast: star from root (control-plane sizes; tree is a later
-  optimization).
+- broadcast: binomial tree from root, ⌈log2 N⌉ rounds (reference
+  ``gloo::broadcast`` tree; the old star was O(N·bytes) serialized at
+  root).
 - alltoall(v): pairwise exchange, N−1 rounds of offset sendrecv.
 
 These run on numpy buffers and serve CPU deployments, multi-process tests,
@@ -29,13 +36,33 @@ from ..core.tensor_queue import Status, TensorTableEntry
 from ..transport.tcp import TcpMesh
 
 
+class FusionBufferManager:
+    """Persistent per-dtype staging buffers (reference
+    ``fusion_buffer_manager.h``): one allocation reused across cycles
+    instead of a fresh tens-of-MB concatenate-and-free per fused response
+    (VERDICT missing #6 — page-fault churn on every cycle)."""
+
+    def __init__(self):
+        self._bufs: dict = {}
+
+    def get(self, dtype: np.dtype, elems: int) -> np.ndarray:
+        dtype = np.dtype(dtype)
+        buf = self._bufs.get(dtype)
+        if buf is None or buf.size < elems:
+            buf = np.empty(max(elems, 1), dtype=dtype)
+            self._bufs[dtype] = buf
+        return buf[:elems]
+
+
 class CollectiveOp:
     """Base op: ``HorovodOp::Execute(entries, response)`` +
     ``Enabled(...)`` (reference ``collective_operations.h:38-87``)."""
 
-    def __init__(self, topo: ProcessTopology, mesh: Optional[TcpMesh]):
+    def __init__(self, topo: ProcessTopology, mesh: Optional[TcpMesh],
+                 fusion_buffers: Optional[FusionBufferManager] = None):
         self.topo = topo
         self.mesh = mesh
+        self.fusion_buffers = fusion_buffers
 
     def enabled(self, response: Response,
                 entries: List[TensorTableEntry]) -> bool:
@@ -58,24 +85,103 @@ def _accum_dtype(dtype: np.dtype) -> np.dtype:
     return dtype
 
 
-def fuse_entries(entries: List[TensorTableEntry], dtype: np.dtype) -> np.ndarray:
+def fuse_entries(entries: List[TensorTableEntry], dtype: np.dtype,
+                 fbm: Optional[FusionBufferManager] = None) -> np.ndarray:
     """MemcpyInFusionBuffer analog (``collective_operations.cc``).
 
-    Always returns a fresh buffer in ``dtype`` — never a view of an entry's
-    tensor, so backends may mutate it freely without corrupting user input."""
+    Never returns a view of an entry's tensor, so backends may mutate the
+    result freely without corrupting user input.  With ``fbm``, multi-entry
+    payloads stage into the persistent fusion buffer (the result then
+    ALIASES the manager's storage — callers must unfuse with ``copy=True``
+    before the next cycle reuses it)."""
     if len(entries) == 1:
         return np.asarray(entries[0].tensor).ravel().astype(dtype, copy=True)
+    if fbm is not None:
+        total = sum(int(np.asarray(e.tensor).size) for e in entries)
+        buf = fbm.get(dtype, total)
+        off = 0
+        for e in entries:
+            arr = np.asarray(e.tensor).ravel()
+            buf[off:off + arr.size] = arr  # casts to `dtype` on assignment
+            off += arr.size
+        return buf
     return np.concatenate(
         [np.asarray(e.tensor).ravel() for e in entries]).astype(dtype, copy=False)
 
 
-def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry]) -> None:
-    """MemcpyOutFusionBuffer analog: slice results into per-entry outputs."""
+def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry],
+                   copy: bool = False) -> None:
+    """MemcpyOutFusionBuffer analog: slice results into per-entry outputs.
+
+    ``copy=True`` materializes each output (required when ``buf`` is the
+    persistent fusion buffer — a view would be silently overwritten by the
+    next fused response)."""
     offset = 0
     for e in entries:
         n = int(np.asarray(e.tensor).size)
-        e.output = buf[offset:offset + n].reshape(np.asarray(e.tensor).shape)
+        out = buf[offset:offset + n].reshape(np.asarray(e.tensor).shape)
+        e.output = out.copy() if copy else out
         offset += n
+
+
+def _scale_inplace(buf: np.ndarray, factor: float, wide: np.dtype) -> None:
+    """Scale, widening for low-precision dtypes (reference ScaleBuffer,
+    ``collective_operations.h:89-125`` widens fp16 through fp32)."""
+    if buf.dtype == wide:
+        buf *= factor
+    else:
+        buf[:] = (buf.astype(wide) * factor).astype(buf.dtype)
+
+
+def _widen_add(chunk: np.ndarray, incoming: np.ndarray,
+               wide: np.dtype) -> None:
+    """chunk += incoming with wide-precision arithmetic: the wire carries
+    NARROW values (half the bytes for bf16/fp16) and only the add widens —
+    the reference's custom MPI fp16 sum (``half.cc``) does exactly this."""
+    if chunk.dtype == wide:
+        chunk += incoming
+    else:
+        chunk[:] = (chunk.astype(wide) + incoming.astype(wide)).astype(
+            chunk.dtype)
+
+
+def _chunk_bounds(n: int, parts: int) -> np.ndarray:
+    base, rem = divmod(n, parts)
+    counts = [base + (1 if c < rem else 0) for c in range(parts)]
+    return np.cumsum([0] + counts)
+
+
+def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
+                         idx: int, wide: np.dtype) -> np.ndarray:
+    """Ring reduce-scatter over ``group`` (ordered global ranks; ``idx`` is
+    our position).  Returns the chunk bounds; afterwards position ``idx``
+    owns the fully reduced chunk ``(idx + 1) % len(group)``."""
+    g = len(group)
+    bounds = _chunk_bounds(buf.size, g)
+    nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
+    for s in range(g - 1):
+        send_c = (idx - s) % g
+        recv_c = (idx - s - 1) % g
+        recv = mesh.sendrecv(
+            nxt, buf[bounds[send_c]:bounds[send_c + 1]].tobytes(), prv)
+        incoming = np.frombuffer(recv, dtype=buf.dtype)
+        _widen_add(buf[bounds[recv_c]:bounds[recv_c + 1]], incoming, wide)
+    return bounds
+
+
+def _ring_allgather_chunks(mesh: TcpMesh, buf: np.ndarray, group: List[int],
+                           idx: int, bounds: np.ndarray) -> None:
+    """Ring allgather of per-position chunks (each position starts owning
+    chunk ``(idx + 1) % g``, the reduce-scatter ownership)."""
+    g = len(group)
+    nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
+    for s in range(g - 1):
+        send_c = (idx + 1 - s) % g
+        recv_c = (idx - s) % g
+        recv = mesh.sendrecv(
+            nxt, buf[bounds[send_c]:bounds[send_c + 1]].tobytes(), prv)
+        buf[bounds[recv_c]:bounds[recv_c + 1]] = np.frombuffer(
+            recv, dtype=buf.dtype)
 
 
 class RingAllreduce(CollectiveOp):
@@ -85,69 +191,117 @@ class RingAllreduce(CollectiveOp):
     def execute(self, response: Response,
                 entries: List[TensorTableEntry]) -> Status:
         np_dtype = response.tensor_type.to_numpy()
-        acc = _accum_dtype(np_dtype)
-        work = fuse_entries(entries, acc)
+        wide = _accum_dtype(np_dtype)
+        # Fuse in the ORIGINAL dtype: the ring sends narrow bytes and
+        # widens only inside the reduction (VERDICT weak #4 — fusing wide
+        # doubled the wire cost of every bf16/fp16 tensor).
+        staged = len(entries) > 1 and self.fusion_buffers is not None
+        work = fuse_entries(entries, np_dtype, self.fusion_buffers)
 
         if response.prescale_factor != 1.0:
-            work *= response.prescale_factor
+            _scale_inplace(work, response.prescale_factor, wide)
 
         if self.topo.size > 1:
-            work = self._ring_allreduce(work)
+            work = self._ring_allreduce(work, wide)
 
         if response.postscale_factor != 1.0:
-            work *= response.postscale_factor
+            _scale_inplace(work, response.postscale_factor, wide)
 
-        out = work.astype(np_dtype, copy=False)
-        unfuse_entries(out, entries)
+        unfuse_entries(work, entries, copy=staged)
         return Status.OK()
 
-    def _ring_allreduce(self, buf: np.ndarray) -> np.ndarray:
-        size, rank = self.topo.size, self.topo.rank
-        nxt, prv = (rank + 1) % size, (rank - 1) % size
-        n = buf.size
-        # chunk c covers [bounds[c], bounds[c+1])
-        base, rem = divmod(n, size)
-        counts = [base + (1 if c < rem else 0) for c in range(size)]
-        bounds = np.cumsum([0] + counts)
+    def _ring_allreduce(self, buf: np.ndarray, wide: np.dtype) -> np.ndarray:
+        group = list(range(self.topo.size))
+        bounds = _ring_reduce_scatter(
+            self.mesh, buf, group, self.topo.rank, wide)
+        _ring_allgather_chunks(
+            self.mesh, buf, group, self.topo.rank, bounds)
+        return buf
 
-        def chunk(c):
-            return buf[bounds[c]:bounds[c + 1]]
 
-        # reduce-scatter: step s, send chunk (rank - s), recv chunk (rank-s-1)
-        for s in range(size - 1):
-            send_c = (rank - s) % size
-            recv_c = (rank - s - 1) % size
-            recv = self.mesh.sendrecv(nxt, chunk(send_c).tobytes(), prv)
-            incoming = np.frombuffer(recv, dtype=buf.dtype)
-            chunk(recv_c)[:] += incoming
-        # allgather: step s, send chunk (rank+1-s), recv chunk (rank-s)
-        for s in range(size - 1):
-            send_c = (rank + 1 - s) % size
-            recv_c = (rank - s) % size
-            recv = self.mesh.sendrecv(nxt, chunk(send_c).tobytes(), prv)
-            chunk(recv_c)[:] = np.frombuffer(recv, dtype=buf.dtype)
+class HierarchicalAllreduce(RingAllreduce):
+    """Two-level allreduce using the LOCAL/CROSS coordinates (reference
+    ``NCCLHierarchicalAllreduce``, ``nccl_operations.cc:194-405``):
+
+      1. intra-host ring reduce-scatter (fast local fabric),
+      2. cross-host ring allreduce of each local rank's chunk — all
+         local ranks drive their cross-host ring IN PARALLEL, so each
+         host moves only 1/local_size of the payload over the slow links,
+      3. intra-host ring allgather.
+
+    Enabled for homogeneous multi-host × multi-local topologies with the
+    host-major rank layout the launcher guarantees; HOROVOD_HIERARCHICAL_
+    ALLREDUCE=0/1 forces it off/on (reference knob, ``common.h:79``)."""
+
+    @staticmethod
+    def applicable(topo: ProcessTopology) -> bool:
+        from ..common import env as env_mod
+
+        if env_mod.get_str("HOROVOD_HIERARCHICAL_ALLREDUCE") in (
+                "0", "false", "False"):
+            return False
+        # The structural requirements are safety, not preference — a forced
+        # "1" cannot override them (heterogeneous hosts would disagree on
+        # chunk bounds in the cross phase and deadlock).
+        return (topo.local_size > 1 and topo.cross_size > 1
+                and topo.is_homogeneous
+                and topo.rank == topo.cross_rank * topo.local_size
+                + topo.local_rank)
+
+    def _ring_allreduce(self, buf: np.ndarray, wide: np.dtype) -> np.ndarray:
+        t = self.topo
+        local_group = [t.cross_rank * t.local_size + l
+                       for l in range(t.local_size)]
+        cross_group = [c * t.local_size + t.local_rank
+                       for c in range(t.cross_size)]
+
+        bounds = _ring_reduce_scatter(
+            self.mesh, buf, local_group, t.local_rank, wide)
+        own = (t.local_rank + 1) % t.local_size
+        seg = buf[bounds[own]:bounds[own + 1]]
+        if seg.size:
+            seg_bounds = _ring_reduce_scatter(
+                self.mesh, seg, cross_group, t.cross_rank, wide)
+            _ring_allgather_chunks(
+                self.mesh, seg, cross_group, t.cross_rank, seg_bounds)
+        _ring_allgather_chunks(
+            self.mesh, buf, local_group, t.local_rank, bounds)
         return buf
 
 
 class RingAllgather(CollectiveOp):
+    """Fused allgatherv: each rank's entries are packed into ONE local
+    block which makes a single trip around the ring; outputs are sliced
+    out by the negotiated per-(tensor, rank) first-dim matrix (reference
+    allgather fusion + displacement math,
+    ``collective_operations.h:140-176``)."""
+
     def enabled(self, response, entries) -> bool:
         return response.response_type == ResponseType.ALLGATHER
 
     def execute(self, response: Response,
                 entries: List[TensorTableEntry]) -> Status:
-        # Single tensor per response (allgather fusion not implemented).
-        entry = entries[0]
-        tensor = np.ascontiguousarray(entry.tensor)
         size, rank = self.topo.size, self.topo.rank
+        k = len(entries)
+        # tensor_sizes is k blocks of `size` per-rank first dims:
+        # dim0 of tensor i on rank r = tensor_sizes[i*size + r].
+        m = response.tensor_sizes
+        tensors = [np.ascontiguousarray(e.tensor) for e in entries]
+        inners = [t.shape[1:] if t.ndim else () for t in tensors]
+        inner_ns = [int(np.prod(i)) if i else 1 for i in inners]
+
         if size == 1:
-            entry.output = tensor.copy()
+            for e, t in zip(entries, tensors):
+                e.output = t.copy()
             return Status.OK()
 
-        # Per-rank first-dim sizes negotiated by the controller.
-        dim0s = response.tensor_sizes
-        inner = tensor.shape[1:] if tensor.ndim else ()
+        def block_elems(r: int) -> int:
+            return sum(m[i * size + r] * inner_ns[i] for i in range(k))
+
+        dtype = tensors[0].dtype
         blocks: List[Optional[np.ndarray]] = [None] * size
-        blocks[rank] = tensor
+        blocks[rank] = np.concatenate([t.ravel() for t in tensors]) \
+            if k > 1 else tensors[0].ravel()
 
         # ring forwarding: at step s we send the block that originated at
         # (rank - s) and receive the one originated at (rank - s - 1)
@@ -156,16 +310,26 @@ class RingAllgather(CollectiveOp):
             send_origin = (rank - s) % size
             recv_origin = (rank - s - 1) % size
             got = self.mesh.sendrecv(nxt, blocks[send_origin].tobytes(), prv)
-            arr = np.frombuffer(got, dtype=tensor.dtype).reshape(
-                (dim0s[recv_origin],) + inner)
+            arr = np.frombuffer(got, dtype=dtype)
+            assert arr.size == block_elems(recv_origin)
             blocks[recv_origin] = arr
 
-        entry.output = np.concatenate([blocks[i] for i in range(size)], axis=0) \
-            if tensor.ndim else np.stack(blocks)
+        for i, e in enumerate(entries):
+            parts = []
+            for r in range(size):
+                off = sum(m[j * size + r] * inner_ns[j] for j in range(i))
+                n = m[i * size + r] * inner_ns[i]
+                parts.append(blocks[r][off:off + n].reshape(
+                    (m[i * size + r],) + inners[i]))
+            e.output = np.concatenate(parts, axis=0)
         return Status.OK()
 
 
-class StarBroadcast(CollectiveOp):
+class TreeBroadcast(CollectiveOp):
+    """Binomial-tree broadcast: ⌈log2 N⌉ rounds, root sends each payload
+    at most log N times instead of N−1 (reference ``gloo::broadcast``
+    tree; VERDICT weak #3 — the old star serialized O(N·bytes) at root)."""
+
     def enabled(self, response, entries) -> bool:
         return response.response_type == ResponseType.BROADCAST
 
@@ -173,22 +337,49 @@ class StarBroadcast(CollectiveOp):
                 entries: List[TensorTableEntry]) -> Status:
         entry = entries[0]
         root = entry.root_rank
-        if self.topo.size == 1:
+        size, rank = self.topo.size, self.topo.rank
+        if size == 1:
             entry.output = np.ascontiguousarray(entry.tensor)
             return Status.OK()
-        if self.topo.rank == root:
-            data = np.ascontiguousarray(entry.tensor)
-            payload = data.tobytes()
-            for peer in range(self.topo.size):
-                if peer != root:
-                    self.mesh.send(peer, payload)
-            entry.output = data
+
+        # Virtual ranks put the root at 0 so the tree math is uniform.
+        vrank = (rank - root) % size
+        if vrank == 0:
+            payload = np.ascontiguousarray(entry.tensor).tobytes()
+            # Never received; may send on every bit below the tree height
+            # (next power of two ≥ size — size itself may not be one).
+            recv_mask = 1 << (size - 1).bit_length()
         else:
-            raw = self.mesh.recv(root)
+            # Receive from the parent: the peer that differs in our lowest
+            # set bit (it got the payload in an earlier round).
+            mask = 1
+            while not (vrank & mask):
+                mask <<= 1
+            parent = ((vrank ^ mask) + root) % size
+            payload = self.mesh.recv(parent)
+            recv_mask = mask
+
+        # Forward to children: every peer vrank|mask for masks below the
+        # one we received on (binomial fan-out).
+        mask = recv_mask >> 1
+        while mask:
+            child_v = vrank | mask
+            if child_v != vrank and child_v < size:
+                self.mesh.send((child_v + root) % size, payload)
+            mask >>= 1
+
+        if vrank == 0:
+            entry.output = np.ascontiguousarray(entry.tensor)
+        else:
             shape = np.asarray(entry.tensor).shape
             entry.output = np.frombuffer(
-                raw, dtype=response.tensor_type.to_numpy()).reshape(shape).copy()
+                payload,
+                dtype=response.tensor_type.to_numpy()).reshape(shape).copy()
         return Status.OK()
+
+
+# Backwards-compatible alias (the star topology is gone; VERDICT weak #3).
+StarBroadcast = TreeBroadcast
 
 
 class PairwiseAlltoall(CollectiveOp):

@@ -29,6 +29,17 @@ def make_secret() -> str:
     return _secrets.token_hex(32)
 
 
+def ensure_job_secret() -> str:
+    """Launcher-side bootstrap: reuse HOROVOD_SECRET_KEY if the caller set
+    one, else generate — and export it so in-process clients (drivers,
+    notification pings) sign consistently with spawned workers."""
+    import os
+
+    key = os.environ.get(env_mod.HOROVOD_SECRET_KEY) or make_secret()
+    os.environ[env_mod.HOROVOD_SECRET_KEY] = key
+    return key
+
+
 def job_secret() -> Optional[bytes]:
     """The job's key from HOROVOD_SECRET_KEY, or None (unsecured dev runs,
     single-process)."""

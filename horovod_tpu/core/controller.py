@@ -62,6 +62,7 @@ class Controller:
     def __init__(self, topology: ProcessTopology, mesh: Optional[TcpMesh],
                  fusion_threshold_bytes: int = 64 * 1024 * 1024,
                  stall_warning_secs: float = 60.0,
+                 stall_shutdown_secs: float = 0.0,
                  cache_capacity: int = 1024,
                  parameter_manager=None):
         from .response_cache import CoordinatorCache, WorkerCacheMirror
@@ -70,6 +71,7 @@ class Controller:
         self.mesh = mesh
         self.fusion_threshold = fusion_threshold_bytes
         self.stall_warning_secs = stall_warning_secs
+        self.stall_shutdown_secs = stall_shutdown_secs
         self._message_table: Dict[str, _TableEntry] = {}
         self._joined_ranks: Set[int] = set()
         self._last_stall_check = time.monotonic()
@@ -365,7 +367,7 @@ class Controller:
             self._cycle_evictions.extend(evicted)
             if bit is not None:
                 self._cycle_assignments.append((bit, first))
-        return Response(
+        resp = Response(
             response_type=rtype,
             tensor_names=[name],
             tensor_type=first.tensor_type,
@@ -375,38 +377,68 @@ class Controller:
             postscale_factor=first.postscale_factor,
             last_joined_rank=min(self._joined_ranks) if self._joined_ranks else -1,
         )
+        # Coordinator-local payload accounting for the fusion threshold.
+        # ALLGATHER tensor_sizes are first dims only; the true bytes scale
+        # by the inner-dim product (available here from the request shape,
+        # not in the wire Response).
+        itemsize = first.tensor_type.itemsize
+        if rtype == ResponseType.ALLGATHER:
+            dim0 = first.tensor_shape[0] if first.tensor_shape else 1
+            inner_n = first.num_elements // max(1, dim0)
+            resp._payload_bytes = sum(tensor_sizes) * inner_n * itemsize
+        else:
+            resp._payload_bytes = sum(tensor_sizes) * itemsize
+        return resp
 
     # ------------------------------------------------------------------
     # fusion
     # ------------------------------------------------------------------
 
+    _FUSIBLE = (ResponseType.ALLREDUCE, ResponseType.ADASUM,
+                ResponseType.ALLGATHER)
+
+    @staticmethod
+    def _fusion_compatible(a: Response, b: Response) -> bool:
+        return (a.response_type == b.response_type
+                and a.tensor_type == b.tensor_type
+                and a.devices == b.devices
+                and a.prescale_factor == b.prescale_factor
+                and a.postscale_factor == b.postscale_factor)
+
     def _fuse_responses(self, responses: List[Response]) -> List[Response]:
-        """Greedy packing of compatible ALLREDUCE responses under the fusion
-        threshold (reference ``FuseResponses``, ``controller.cc:859-998``;
-        we skip its mixed-precision look-ahead — profitable only with the
-        reference's strict FIFO scan)."""
+        """FIFO scan with look-ahead (reference ``FuseResponses``,
+        ``controller.cc:859-998``): pop the front response, then sweep the
+        REMAINING queue for compatible ones to pack under the threshold —
+        interleaved dtypes no longer defeat fusion (they merely get skipped
+        and seed their own buckets).  ALLREDUCE/ADASUM fuse flat element
+        counts; ALLGATHER fuses whole per-rank size blocks (each tensor
+        contributes ``size`` entries to ``tensor_sizes``)."""
         fused: List[Response] = []
-        for resp in responses:
-            if resp.response_type not in (ResponseType.ALLREDUCE,):
+        pending = list(responses)
+        while pending:
+            resp = pending.pop(0)
+            if resp.response_type not in self._FUSIBLE:
                 fused.append(resp)
                 continue
-            target = None
-            if fused:
-                last = fused[-1]
-                if (last.response_type == resp.response_type
-                        and last.tensor_type == resp.tensor_type
-                        and last.devices == resp.devices
-                        and last.prescale_factor == resp.prescale_factor
-                        and last.postscale_factor == resp.postscale_factor):
-                    itemsize = resp.tensor_type.itemsize
-                    if (sum(last.tensor_sizes) + sum(resp.tensor_sizes)) * itemsize \
-                            <= self.fusion_threshold:
-                        target = last
-            if target is None:
-                fused.append(resp)
-            else:
-                target.tensor_names.extend(resp.tensor_names)
-                target.tensor_sizes.extend(resp.tensor_sizes)
+            itemsize = resp.tensor_type.itemsize
+
+            def payload_bytes(r: Response) -> int:
+                return getattr(r, "_payload_bytes",
+                               sum(r.tensor_sizes) * itemsize)
+
+            total = payload_bytes(resp)
+            rest: List[Response] = []
+            for cand in pending:
+                cand_bytes = payload_bytes(cand)
+                if (self._fusion_compatible(resp, cand)
+                        and total + cand_bytes <= self.fusion_threshold):
+                    resp.tensor_names.extend(cand.tensor_names)
+                    resp.tensor_sizes.extend(cand.tensor_sizes)
+                    total += cand_bytes
+                else:
+                    rest.append(cand)
+            pending = rest
+            fused.append(resp)
         return fused
 
     # ------------------------------------------------------------------
@@ -414,21 +446,46 @@ class Controller:
     # ------------------------------------------------------------------
 
     def _check_stalls(self) -> None:
+        # The shutdown deadline is independent of the warning: disabling
+        # stall WARNINGS must not silently disable the hard abort, and a
+        # shutdown time shorter than the warning time must still fire on
+        # its own schedule.
+        warn, shut = self.stall_warning_secs, self.stall_shutdown_secs
+        enabled = [t for t in (warn, shut) if t > 0]
+        if not enabled:
+            return
         now = time.monotonic()
-        if self.stall_warning_secs <= 0 or \
-                now - self._last_stall_check < self.stall_warning_secs:
+        if now - self._last_stall_check < min(enabled):
             return
         self._last_stall_check = now
         for name, entry in self._message_table.items():
             age = now - entry.first_seen
-            if age > self.stall_warning_secs:
-                missing = sorted(set(range(self.topo.size))
-                                 - entry.ranks - self._joined_ranks)
-                log.warning(
-                    "One or more tensors were submitted to be reduced, gathered "
-                    "or broadcasted by subset of ranks and are waiting for the "
-                    "remainder: %s stalled for %.0fs, missing ranks: %s",
-                    name, age, missing)
+            missing = sorted(set(range(self.topo.size))
+                             - entry.ranks - self._joined_ranks)
+            if shut > 0 and age > shut:
+                # Hard abort (reference stall_inspector.h:77-80): tearing
+                # down the coordinator breaks the mesh, so every healthy
+                # rank surfaces a HorovodInternalError instead of hanging
+                # forever on the missing ones.
+                from ..common.exceptions import HorovodInternalError
+
+                raise HorovodInternalError(
+                    f"stall shutdown: tensor {name} incomplete for "
+                    f"{age:.0f}s (> {shut}s), missing ranks {missing}")
+            if warn <= 0 or age <= warn:
+                continue
+            log.warning(
+                "One or more tensors were submitted to be reduced, gathered "
+                "or broadcasted by subset of ranks and are waiting for the "
+                "remainder: %s stalled for %.0fs, missing ranks: %s",
+                name, age, missing)
+            # A stalled tensor's cached negotiation is stale
+            # (reference InvalidateStalledCachedTensors): evict so any
+            # post-recovery resubmission renegotiates from scratch.
+            if self._cache is not None:
+                bit = self._cache.invalidate_name(name)
+                if bit is not None:
+                    self._cycle_evictions.append(bit)
 
     # ------------------------------------------------------------------
     # small collective helpers for init/shutdown/elastic paths

@@ -98,6 +98,18 @@ class CoordinatorCache:
         self._by_name[req.tensor_name] = bit
         return bit, evicted
 
+    def invalidate_name(self, name: str) -> Optional[int]:
+        """Evict a tensor's entry by name; returns the freed bit.
+
+        Reference ``InvalidateStalledCachedTensors``: a stalled tensor's
+        cached negotiation must not survive the stall — after recovery the
+        tensor renegotiates from scratch."""
+        bit = self._by_name.get(name)
+        if bit is None:
+            return None
+        self._evict(bit)
+        return bit
+
     def _evict(self, bit: int) -> None:
         entry = self._by_bit.pop(bit, None)
         if entry is None:

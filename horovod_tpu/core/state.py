@@ -148,6 +148,8 @@ class HorovodGlobalState:
             topo, self.mesh,
             fusion_threshold_bytes=fusion,
             stall_warning_secs=stall_secs,
+            stall_shutdown_secs=env_mod.get_float(
+                env_mod.HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
             cache_capacity=env_mod.get_int(env_mod.HOROVOD_CACHE_CAPACITY,
                                            env_mod.DEFAULT_CACHE_CAPACITY),
             parameter_manager=self.parameter_manager)
@@ -168,6 +170,10 @@ class HorovodGlobalState:
     def _register_default_ops(self) -> None:
         topo, mesh = self.topo, self.mesh
         self.op_manager = OperationManager()
+        # One persistent staging arena shared by every host-side op
+        # (reference: one FusionBufferManager in HorovodGlobalState).
+        self.fusion_buffers = cpu_ring.FusionBufferManager()
+        fbm = self.fusion_buffers
         # XLA device ops lead each chain (reference registration order,
         # operations.cc:145-252: most-specialized backend first); their
         # enabled() checks the negotiated device set, so every rank makes
@@ -180,23 +186,30 @@ class HorovodGlobalState:
             ResponseType.ALLGATHER, xla_backend.XlaAllgather(topo))
         self.op_manager.register(
             ResponseType.BROADCAST, xla_backend.XlaBroadcast(topo))
+        # Hierarchical ahead of the flat ring (reference chain order,
+        # operations.cc:145-252: NCCL-hierarchical before NCCL); applicable()
+        # is pure topology, so every rank registers identically.
+        if cpu_ring.HierarchicalAllreduce.applicable(topo):
+            self.op_manager.register(
+                ResponseType.ALLREDUCE,
+                cpu_ring.HierarchicalAllreduce(topo, mesh, fbm))
         self.op_manager.register(
-            ResponseType.ALLREDUCE, cpu_ring.RingAllreduce(topo, mesh))
+            ResponseType.ALLREDUCE, cpu_ring.RingAllreduce(topo, mesh, fbm))
         self.op_manager.register(
             ResponseType.ALLGATHER, cpu_ring.RingAllgather(topo, mesh))
         self.op_manager.register(
-            ResponseType.BROADCAST, cpu_ring.StarBroadcast(topo, mesh))
+            ResponseType.BROADCAST, cpu_ring.TreeBroadcast(topo, mesh))
         self.op_manager.register(
             ResponseType.ALLTOALL, cpu_ring.PairwiseAlltoall(topo, mesh))
         from ..backend.adasum import AdasumAllreduce, AdasumRingFallback
 
         self.op_manager.register(
-            ResponseType.ADASUM, AdasumAllreduce(topo, mesh))
+            ResponseType.ADASUM, AdasumAllreduce(topo, mesh, fbm))
         # Non-power-of-two worlds fall back to an averaging ring allreduce
         # (the reference simply rejects them; averaging approximates
         # Adasum's identical-gradient behavior and keeps hvd.Adasum usable).
         self.op_manager.register(
-            ResponseType.ADASUM, AdasumRingFallback(topo, mesh))
+            ResponseType.ADASUM, AdasumRingFallback(topo, mesh, fbm))
 
     # ------------------------------------------------------------------
     # background loop

@@ -20,7 +20,12 @@ from ..common import env as env_mod
 from ..common.logging_util import get_logger
 from ..runner import config_parser
 from ..runner.hosts import SlotInfo, parse_host_files, parse_hosts
-from ..runner.launch import _is_local, _ssh_command, _slot_env, _OutputPump
+from ..runner.launch import (
+    _is_local,
+    _slot_env,
+    _OutputPump,
+    spawn_worker,
+)
 from ..runner.rendezvous import RendezvousServer
 from .discovery import FixedHosts, HostDiscoveryScript, HostManager
 from .driver import ElasticDriver
@@ -42,10 +47,7 @@ def launch_elastic_job(args, command: List[str]) -> int:
 
     from ..common import secret as secret_mod
 
-    job_secret = (os.environ.get(env_mod.HOROVOD_SECRET_KEY)
-                  or secret_mod.make_secret())
-    os.environ[env_mod.HOROVOD_SECRET_KEY] = job_secret
-
+    job_secret = secret_mod.ensure_job_secret()
     server = RendezvousServer(bind_addr="0.0.0.0",
                               job_secret=job_secret.encode())
     port = server.start()
@@ -82,16 +84,7 @@ def launch_elastic_job(args, command: List[str]) -> int:
                         else "127.0.0.1", port, extra,
                         tpu_chip_binding=False)
         env["HOROVOD_EPOCH"] = str(epoch)
-        local = _is_local(slot.hostname)
-        cmd = command if local else _ssh_command(slot, command, env)
-        proc = subprocess.Popen(cmd, env=env, text=True,
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE,
-                                stdin=None if local else subprocess.PIPE)
-        if not local:  # HMAC key over stdin (see _ssh_command)
-            proc.stdin.write(env[env_mod.HOROVOD_SECRET_KEY] + "\n")
-            proc.stdin.flush()
-            proc.stdin.close()
+        proc = spawn_worker(slot, command, env)
         identity = f"{slot.hostname}:{slot.local_rank}"
         with lock:
             procs[identity] = proc

@@ -332,7 +332,15 @@ class _DistributedOptimizer:
             ctx = getattr(self, "_ctx_for", {}).get(name)
             out = self._compression.decompress(out, ctx)
             if p.grad is None:
-                continue  # zero-substituted: keep torch's skip semantics
+                # Zero-substituted param.  If the REDUCED gradient is
+                # nonzero, another rank used this param, and skipping the
+                # write-back would diverge the replicas — materialize and
+                # apply like every other rank.  If it is zero on every rank
+                # (same tensor everywhere), keep torch's grad-None skip so
+                # weight decay/momentum don't drift params nobody used.
+                if not bool((out != 0).any()):
+                    continue
+                p.grad = torch.zeros_like(p)
             with torch.no_grad():
                 p.grad.copy_(out.reshape(p.grad.shape).to(p.grad.dtype))
         self._handles.clear()

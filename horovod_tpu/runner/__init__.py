@@ -44,16 +44,10 @@ def run(func, args: tuple = (), kwargs: Optional[dict] = None,
             f"horovod_tpu.runner.run() executes on the local machine only; "
             f"remote hosts {remote} need the hvdrun CLI (ssh launch)")
 
-    import os as _os
-
-    from ..common import env as _env
     from ..common import secret as _secret
 
-    job_secret = (_os.environ.get(_env.HOROVOD_SECRET_KEY)
-                  or _secret.make_secret())
-    _os.environ[_env.HOROVOD_SECRET_KEY] = job_secret
     server = RendezvousServer(bind_addr="127.0.0.1",
-                              job_secret=job_secret.encode())
+                              job_secret=_secret.ensure_job_secret().encode())
     port = server.start()
     server.set(FUNC_SCOPE, "payload",
                pickler.dumps((func, args, kwargs or {})))

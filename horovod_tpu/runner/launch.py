@@ -122,6 +122,22 @@ def _slot_env(slot: SlotInfo, rdv_addr: str, rdv_port: int,
     return env
 
 
+def spawn_worker(slot: SlotInfo, command: List[str],
+                 env: Dict[str, str]) -> subprocess.Popen:
+    """Spawn one slot's worker: local exec or ssh; remote workers receive
+    the job's HMAC key over stdin (never argv — see _ssh_command)."""
+    local = _is_local(slot.hostname)
+    cmd = command if local else _ssh_command(slot, command, env)
+    proc = subprocess.Popen(
+        cmd, env=env, text=True, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, stdin=None if local else subprocess.PIPE)
+    if not local:
+        proc.stdin.write(env[env_mod.HOROVOD_SECRET_KEY] + "\n")
+        proc.stdin.flush()
+        proc.stdin.close()
+    return proc
+
+
 def host_slots_of(slots: List[SlotInfo]) -> List:
     """Ordered (hostname, n_slots) pairs of a job's slot list — the
     slice-wide shape every rank must agree on for TPU process tiling."""
@@ -227,15 +243,10 @@ def launch_job(args, command: List[str]) -> int:
     tpu_chip_binding = False if args.no_tpu_chip_binding else None
     job_host_slots = host_slots_of(slots)
 
-    # Per-job HMAC key for every service-plane RPC (reference secret.py:36);
-    # exported into our own env too so in-process clients (driver,
-    # notification) sign consistently.
+    # Per-job HMAC key for every service-plane RPC (reference secret.py:36).
     from ..common import secret as secret_mod
 
-    job_secret = (os.environ.get(env_mod.HOROVOD_SECRET_KEY)
-                  or secret_mod.make_secret())
-    os.environ[env_mod.HOROVOD_SECRET_KEY] = job_secret
-
+    job_secret = secret_mod.ensure_job_secret()
     server = RendezvousServer(bind_addr="0.0.0.0",
                               job_secret=job_secret.encode())
     port = server.start()
@@ -267,16 +278,7 @@ def launch_job(args, command: List[str]) -> int:
             env = _slot_env(slot, rdv_addr, port, extra,
                             tpu_chip_binding=tpu_chip_binding,
                             job_host_slots=job_host_slots)
-            local = _is_local(slot.hostname)
-            cmd = command if local else _ssh_command(slot, command, env)
-            proc = subprocess.Popen(
-                cmd, env=env, text=True, stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                stdin=None if local else subprocess.PIPE)
-            if not local:  # hand the HMAC key over stdin (see _ssh_command)
-                proc.stdin.write(env[env_mod.HOROVOD_SECRET_KEY] + "\n")
-                proc.stdin.flush()
-                proc.stdin.close()
+            proc = spawn_worker(slot, command, env)
             procs.append(proc)
             if args.output_filename:
                 rank_dir = os.path.join(args.output_filename,

@@ -102,35 +102,38 @@ class TcpMesh:
 
     # -- handshake ----------------------------------------------------------
     #
-    # dialer:   HELLO + my_rank [+ HMAC]  →
-    # acceptor:                            ←  HELLO + its_rank [+ HMAC]
+    # dialer:   HELLO + my_rank + target_rank [+ HMAC]  →
+    # acceptor:                    ←  HELLO + its_rank + dialer_rank [+ HMAC]
     #
-    # The ack lets a dialer detect that a candidate address reached the
-    # wrong machine (multi-homed hosts) and fall through to the next one;
-    # the HMAC (when HOROVOD_SECRET_KEY is set) keeps arbitrary LAN peers
-    # out of the data fabric (reference network.py:50-85 role).
+    # Carrying the intended TARGET lets the acceptor refuse (without
+    # registering) a connection that reached the wrong machine — with
+    # multi-addr advertisement a dial can land on another rank's listener,
+    # and registering it would leave that rank holding a socket its dialer
+    # is about to close.  The HMAC (when HOROVOD_SECRET_KEY is set) keeps
+    # arbitrary LAN peers out of the data fabric (reference
+    # network.py:50-85 role).
 
-    def _hello_blob(self, rank: int) -> bytes:
-        blob = _HELLO + struct.pack("<I", rank)
+    def _hello_blob(self, my_rank: int, target_rank: int) -> bytes:
+        blob = _HELLO + struct.pack("<II", my_rank, target_rank)
         if self._secret is not None:
             from ..common import secret as secret_mod
 
             blob += secret_mod.sign_blob(self._secret, blob)
         return blob
 
-    def _check_hello(self, data: bytes) -> int:
-        """Validate magic+sig; returns the peer rank or raises."""
+    def _check_hello(self, data: bytes) -> tuple:
+        """Validate magic+sig; returns (peer_rank, intended_target)."""
         if data[:4] != _HELLO:
             raise HorovodInternalError("bad tcp mesh hello")
         if self._secret is not None:
             from ..common import secret as secret_mod
 
-            if not secret_mod.verify_blob(self._secret, data[:8], data[8:]):
+            if not secret_mod.verify_blob(self._secret, data[:12], data[12:]):
                 raise HorovodInternalError("tcp mesh hello failed HMAC check")
-        return struct.unpack("<I", data[4:8])[0]
+        return struct.unpack("<II", data[4:12])
 
     def _hello_len(self) -> int:
-        return 8 + (32 if self._secret is not None else 0)
+        return 12 + (32 if self._secret is not None else 0)
 
     def _dial_peer(self, target: int, endpoints: List,
                    timeout: float) -> socket.socket:
@@ -146,8 +149,8 @@ class TcpMesh:
                     # answers must fall through to the next candidate, not
                     # hang the mesh (symmetric with the accept side).
                     sock.settimeout(5.0)
-                    sock.sendall(self._hello_blob(self.rank))
-                    got = self._check_hello(
+                    sock.sendall(self._hello_blob(self.rank, target))
+                    got, _ = self._check_hello(
                         _recv_exact(sock, self._hello_len()))
                     if got != target:
                         sock.close()
@@ -173,12 +176,18 @@ class TcpMesh:
                 try:
                     _configure(sock)
                     sock.settimeout(5.0)
-                    peer_rank = self._check_hello(
+                    peer_rank, intended = self._check_hello(
                         _recv_exact(sock, self._hello_len()))
-                    sock.sendall(self._hello_blob(self.rank))
+                    # Always answer with our identity so a misrouted dialer
+                    # learns who it reached and falls through to its next
+                    # candidate; only register connections MEANT for us.
+                    sock.sendall(self._hello_blob(self.rank, peer_rank))
+                    if intended != self.rank:
+                        sock.close()
+                        continue
                     sock.settimeout(None)
                 except (OSError, HorovodInternalError):
-                    # Unauthenticated or misrouted connection: drop it
+                    # Unauthenticated or malformed connection: drop it
                     # without counting toward the expected peer set.
                     sock.close()
                     continue

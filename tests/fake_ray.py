@@ -1,0 +1,136 @@
+"""A minimal in-repo `ray` stand-in for testing horovod_tpu.ray.
+
+Implements just the surface the integration uses — ``ray.remote(cls)``,
+``.options().remote()`` actor construction, ``actor.method.remote()`` →
+ref, ``ray.get``, ``ray.kill``, ``ray.nodes`` — with REAL subprocess
+actors (spawn context) so hvd.init() runs in isolated processes exactly
+like under real Ray.  Tests inject it as ``sys.modules['ray']``.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List
+
+import cloudpickle
+
+_ctx = mp.get_context("spawn")
+
+# Configurable cluster state for ray.nodes()
+NODES: List[Dict[str, Any]] = []
+
+
+def _actor_server(conn, cls_blob):
+    cls, args, kwargs = cloudpickle.loads(cls_blob)
+    inst = cls(*args, **kwargs)
+    while True:
+        try:
+            msg = conn.recv_bytes()
+        except (EOFError, OSError):
+            return
+        method, a, kw = cloudpickle.loads(msg)
+        if method == "__stop__":
+            return
+        try:
+            result = ("ok", getattr(inst, method)(*a, **kw))
+        except BaseException as e:  # noqa: BLE001 — marshalled to caller
+            result = ("err", repr(e))
+        try:
+            conn.send_bytes(cloudpickle.dumps(result))
+        except (OSError, BrokenPipeError):
+            return
+
+
+class ObjectRef:
+    def __init__(self, future: Future):
+        self.future = future
+
+
+class _MethodProxy:
+    def __init__(self, actor: "ActorHandle", name: str):
+        self._actor = actor
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._actor._call(self._name, args, kwargs)
+
+
+class ActorHandle:
+    def __init__(self, cls, args, kwargs):
+        parent, child = _ctx.Pipe()
+        self._conn = parent
+        self._proc = _ctx.Process(
+            target=_actor_server,
+            args=(child, cloudpickle.dumps((cls, args, kwargs))),
+            daemon=True)
+        self._proc.start()
+        child.close()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._pump.start()
+        self._methods = {name for name in dir(cls)
+                         if not name.startswith("_")}
+
+    def _pump_loop(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            payload, future = item
+            try:
+                self._conn.send_bytes(payload)
+                status, value = cloudpickle.loads(self._conn.recv_bytes())
+            except BaseException as e:  # noqa: BLE001 — actor died
+                future.set_exception(RuntimeError(f"actor died: {e}"))
+                continue
+            if status == "ok":
+                future.set_result(value)
+            else:
+                future.set_exception(RuntimeError(value))
+
+    def _call(self, method, args, kwargs) -> ObjectRef:
+        future: Future = Future()
+        self._queue.put((cloudpickle.dumps((method, args, kwargs)), future))
+        return ObjectRef(future)
+
+    def __getattr__(self, name):
+        if name in self.__dict__.get("_methods", ()):
+            return _MethodProxy(self, name)
+        raise AttributeError(name)
+
+    def _kill(self):
+        self._queue.put(None)
+        self._proc.terminate()
+        self._conn.close()
+
+
+class RemoteClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def options(self, **_kwargs) -> "RemoteClass":
+        return self
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return ActorHandle(self._cls, args, kwargs)
+
+
+def remote(cls) -> RemoteClass:
+    return RemoteClass(cls)
+
+
+def get(refs, timeout=None):
+    if isinstance(refs, ObjectRef):
+        return refs.future.result(timeout)
+    return [r.future.result(timeout) for r in refs]
+
+
+def kill(actor: ActorHandle) -> None:
+    actor._kill()
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return list(NODES)

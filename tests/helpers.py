@@ -40,13 +40,21 @@ print("WORKER_OK", rank)
 
 def run_distributed(n: int, body: str, timeout: float = 120,
                     extra_env: Optional[Dict[str, str]] = None,
-                    expect_failure: bool = False) -> List[str]:
-    """Run `body` on n worker processes; returns per-rank stdout."""
+                    expect_failure: bool = False,
+                    local_size: Optional[int] = None) -> List[str]:
+    """Run `body` on n worker processes; returns per-rank stdout.
+
+    ``local_size`` simulates a host-major multi-host topology (n must
+    divide evenly): rank r gets local_rank r%local_size, cross_rank
+    r//local_size — how hierarchical-allreduce paths are tested without
+    real multi-host."""
     from horovod_tpu.runner.rendezvous import RendezvousServer
 
     server = RendezvousServer(bind_addr="127.0.0.1")
     port = server.start()
     script = PREAMBLE + body + ("" if expect_failure else EPILOGUE)
+    ls = local_size or n
+    assert n % ls == 0, "local_size must divide n"
     procs = []
     try:
         for r in range(n):
@@ -54,10 +62,10 @@ def run_distributed(n: int, body: str, timeout: float = 120,
             env.update({
                 "HOROVOD_RANK": str(r),
                 "HOROVOD_SIZE": str(n),
-                "HOROVOD_LOCAL_RANK": str(r),
-                "HOROVOD_LOCAL_SIZE": str(n),
-                "HOROVOD_CROSS_RANK": "0",
-                "HOROVOD_CROSS_SIZE": "1",
+                "HOROVOD_LOCAL_RANK": str(r % ls),
+                "HOROVOD_LOCAL_SIZE": str(ls),
+                "HOROVOD_CROSS_RANK": str(r // ls),
+                "HOROVOD_CROSS_SIZE": str(n // ls),
                 "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
                 "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
                 "JAX_PLATFORMS": "cpu",

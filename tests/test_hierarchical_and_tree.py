@@ -1,0 +1,78 @@
+"""Hierarchical allreduce, tree broadcast, and narrow-wire low-precision
+reduction (reference ``nccl_operations.cc:194-405`` two-level pattern,
+``gloo::broadcast`` tree, ``half.cc`` narrow-wire fp16 sum)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.backend.cpu_ring import HierarchicalAllreduce
+from horovod_tpu.common.topology import ProcessTopology
+
+from .helpers import run_distributed
+
+
+def _topo(rank, size, lr, ls, cr, cs):
+    return ProcessTopology(rank=rank, size=size, local_rank=lr,
+                           local_size=ls, cross_rank=cr, cross_size=cs)
+
+
+def test_hierarchical_applicable():
+    # 2 hosts x 2 slots, host-major: applicable
+    assert HierarchicalAllreduce.applicable(_topo(3, 4, 1, 2, 1, 2))
+    # single host: flat ring is the right tool
+    assert not HierarchicalAllreduce.applicable(_topo(1, 4, 1, 4, 0, 1))
+    # one slot per host: nothing to split locally
+    assert not HierarchicalAllreduce.applicable(_topo(1, 4, 0, 1, 1, 4))
+
+
+def test_hierarchical_applicable_env_off(monkeypatch):
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "0")
+    assert not HierarchicalAllreduce.applicable(_topo(3, 4, 1, 2, 1, 2))
+
+
+def test_hierarchical_allreduce_2x2():
+    """4 ranks as 2 hosts x 2 slots: the two-level path must give exact
+    sums (fp32) and rank-dependent values catch chunk-routing bugs."""
+    out = run_distributed(4, """
+x = np.arange(23, dtype=np.float32) * (rank + 1) + rank
+o = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="h"))
+exp = sum(np.arange(23, dtype=np.float32) * (r + 1) + r for r in range(4))
+assert np.allclose(o, exp), (o[:4], exp[:4])
+# a second, larger tensor re-uses the path (uneven chunk bounds)
+y = np.ones(101, np.float32) * (rank + 1)
+o2 = np.asarray(hvd.allreduce(y, op=hvd.Average, name="h2"))
+assert np.allclose(o2, 2.5), o2[:4]
+print("HIER_OK", rank, flush=True)
+""", timeout=240, local_size=2)
+    for r, o in enumerate(out):
+        assert f"HIER_OK {r}" in o
+
+
+@pytest.mark.parametrize("n", [3, 5])
+def test_tree_broadcast_non_pow2(n):
+    """Binomial tree must cover every rank for non-power-of-two sizes and
+    non-zero roots."""
+    out = run_distributed(n, f"""
+root = {n - 1}
+val = np.arange(7, dtype=np.float64) * 3.5 if rank == root else np.zeros(7)
+o = np.asarray(hvd.broadcast(val, root_rank=root, name="tb"))
+assert np.allclose(o, np.arange(7) * 3.5), o
+print("TREE_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"TREE_OK {r}" in o
+
+
+def test_bf16_narrow_wire_allreduce():
+    """bf16 stays bf16 on the wire; sums of small integers are exact in
+    bf16 so the result must round-trip exactly."""
+    out = run_distributed(2, """
+import ml_dtypes
+x = np.arange(16, dtype=ml_dtypes.bfloat16)
+o = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="b"))
+assert o.dtype == ml_dtypes.bfloat16, o.dtype
+assert np.allclose(o.astype(np.float32), np.arange(16) * 2.0), o
+print("BF16_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"BF16_OK {r}" in o

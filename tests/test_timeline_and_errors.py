@@ -98,3 +98,28 @@ else:
         assert "joined" in str(e).lower(), str(e)
     hvd.join()
 """)
+
+
+def test_stall_shutdown_aborts_job():
+    """HOROVOD_STALL_SHUTDOWN_TIME_SECONDS must hard-abort instead of
+    hanging forever when a rank never submits (reference
+    stall_inspector.h:77-80; the flag was previously parsed but dead)."""
+    out = run_distributed(2, """
+import time
+from horovod_tpu.common.exceptions import HorovodInternalError
+if rank == 0:
+    try:
+        hvd.allreduce(np.ones(4), op=hvd.Sum, name="never")
+        print("STALL_NOT_DETECTED", flush=True)
+    except HorovodInternalError as e:
+        assert "stall shutdown" in str(e), e
+        print("STALL_ABORT_OK", flush=True)
+else:
+    time.sleep(30)   # never submits 'never'
+print("DONE", rank, flush=True)
+""", timeout=120, expect_failure=True,
+                          extra_env={
+                              "HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+                              "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "3",
+                          })
+    assert "STALL_ABORT_OK" in out[0], out[0]

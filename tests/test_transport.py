@@ -225,7 +225,7 @@ def test_tcp_mesh_authenticated_hello(monkeypatch):
                 time.sleep(0.01)
             host, port = val.decode().split(",")[0].rsplit(":", 1)
             s = socket_mod.create_connection((host, int(port)), timeout=5)
-            s.sendall(b"HVMT\x00\x00\x00\x00" + b"\x00" * 32)  # bad sig
+            s.sendall(b"HVMT" + b"\x00" * 8 + b"\x00" * 32)  # bad sig
         except OSError:
             pass  # mesh dropping us mid-write is the expected outcome
 
