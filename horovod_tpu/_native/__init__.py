@@ -1,0 +1,173 @@
+"""Loader for the native hot-path kernels (``native.cc``).
+
+The reference ships its arithmetic as C++ compiled at pip-install time
+(setup.py → CMake).  Here the shared library is built by ``setup.py``'s
+``build_ext`` when the package is installed — and, for source checkouts
+(tests, the driver), compiled on first import with ``g++`` into the
+package directory and cached.  No pybind11: the kernels expose a plain C
+ABI consumed via ctypes.
+
+``lib()`` returns the loaded CDLL or None (no compiler, build failure) —
+callers keep a numpy fallback, so the native layer is a pure accelerator,
+never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..common.logging_util import get_logger
+
+log = get_logger("horovod_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "native.cc")
+_SO = os.path.join(_DIR, "libhvdnative.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    # Compile to a per-process temp name and rename into place: multiple
+    # workers on one host race this on first use, and a peer dlopen-ing a
+    # half-linked .so would SIGBUS mid-training.  rename() is atomic.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native kernel build failed (%s); using numpy paths", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
+    ptr = ctypes.c_void_p  # buffers pass as raw addresses
+    f64, size_t = ctypes.c_double, ctypes.c_size_t
+    for name, args in {
+        "hvd_add_bf16": [ptr, ptr, size_t],
+        "hvd_add_f16": [ptr, ptr, size_t],
+        "hvd_add_f32": [ptr, ptr, size_t],
+        "hvd_add_f64": [ptr, ptr, size_t],
+        "hvd_scale_bf16": [ptr, f64, size_t],
+        "hvd_scale_f16": [ptr, f64, size_t],
+        "hvd_scale_f32": [ptr, f64, size_t],
+        "hvd_scale_f64": [ptr, f64, size_t],
+        "hvd_dot3_f32": [ptr, ptr, size_t, ptr],
+        "hvd_dot3_f64": [ptr, ptr, size_t, ptr],
+        "hvd_combine_f32": [ptr, ptr, f64, f64, size_t],
+        "hvd_combine_f64": [ptr, ptr, f64, f64, size_t],
+    }.items():
+        fn = getattr(cdll, name)
+        fn.argtypes = args
+        fn.restype = None
+    cdll.hvd_native_abi_version.restype = ctypes.c_int
+    return cdll
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The native kernel library, building it on first call if needed."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("HOROVOD_DISABLE_NATIVE"):
+            return None
+        needs_build = (not os.path.exists(_SO)
+                       or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if needs_build and not _build():
+            return None
+        try:
+            # AttributeError covers a stale .so missing newer symbols —
+            # native must degrade to numpy, never crash a collective.
+            cdll = _bind(ctypes.CDLL(_SO))
+            if cdll.hvd_native_abi_version() != 1:
+                raise OSError("ABI version mismatch")
+            _lib = cdll
+        except (OSError, AttributeError) as e:
+            log.warning("native kernel load failed (%s); using numpy", e)
+            _lib = None
+    return _lib
+
+
+# ---------------------------------------------------------------------------
+# numpy-facing wrappers (contiguity/dtype checked here, not in C)
+# ---------------------------------------------------------------------------
+
+def _suffix(dtype: np.dtype) -> Optional[str]:
+    name = np.dtype(dtype).name
+    return {"bfloat16": "bf16", "float16": "f16",
+            "float32": "f32", "float64": "f64"}.get(name)
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def add_inplace(dst: np.ndarray, src: np.ndarray) -> bool:
+    """dst += src with wide accumulation; True when handled natively.
+
+    The size check mirrors numpy's broadcast ValueError: a short peer
+    buffer must fail loudly, not over-read the heap."""
+    cdll = lib()
+    sfx = _suffix(dst.dtype)
+    if cdll is None or sfx is None or dst.dtype != src.dtype \
+            or dst.size != src.size \
+            or not dst.flags.c_contiguous or not src.flags.c_contiguous:
+        return False
+    getattr(cdll, f"hvd_add_{sfx}")(_ptr(dst), _ptr(src), dst.size)
+    return True
+
+
+def scale_inplace(buf: np.ndarray, factor: float) -> bool:
+    cdll = lib()
+    sfx = _suffix(buf.dtype)
+    if cdll is None or sfx is None or not buf.flags.c_contiguous:
+        return False
+    getattr(cdll, f"hvd_scale_{sfx}")(_ptr(buf), float(factor), buf.size)
+    return True
+
+
+def dot3(a: np.ndarray, b: np.ndarray):
+    """(dot(a,b), ||a||², ||b||²) in one pass with f64 accumulation, or
+    None when the native path can't take it."""
+    cdll = lib()
+    sfx = _suffix(a.dtype)
+    if cdll is None or sfx not in ("f32", "f64") or a.dtype != b.dtype \
+            or a.size != b.size \
+            or not a.flags.c_contiguous or not b.flags.c_contiguous:
+        return None
+    out = np.empty(3, dtype=np.float64)
+    getattr(cdll, f"hvd_dot3_{sfx}")(_ptr(a), _ptr(b), a.size, _ptr(out))
+    return float(out[0]), float(out[1]), float(out[2])
+
+
+def combine_inplace(a: np.ndarray, b: np.ndarray, ca: float,
+                    cb: float) -> bool:
+    """a = ca*a + cb*b (the Adasum combine); True when handled natively."""
+    cdll = lib()
+    sfx = _suffix(a.dtype)
+    if cdll is None or sfx not in ("f32", "f64") or a.dtype != b.dtype \
+            or a.size != b.size \
+            or not a.flags.c_contiguous or not b.flags.c_contiguous:
+        return False
+    getattr(cdll, f"hvd_combine_{sfx}")(_ptr(a), _ptr(b), float(ca),
+                                        float(cb), a.size)
+    return True

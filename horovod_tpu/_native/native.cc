@@ -1,0 +1,193 @@
+// Native hot-path kernels for the host-side (Gloo-role) data plane.
+//
+// Role of the reference's C++ core arithmetic: half.cc (fp16 widen-add MPI
+// sum op with F16C fast path), collective_operations.h:89-125 (ScaleBuffer
+// with AVX fp16 path), adasum/adasum.h:101-140 (fused dot/norm kernels).
+// Python/numpy needs 3 full passes plus temporaries for the
+// widen-add-narrow reduction step of the TCP ring (bf16 -> f32 -> add ->
+// bf16); these kernels do it in one pass.  Exposed as a plain C ABI and
+// loaded via ctypes (no pybind11 in this image); built by
+// horovod_tpu/_native/__init__.py with g++ on first use and by setup.py at
+// install time.
+//
+// All kernels operate on contiguous buffers; the Python wrapper enforces
+// contiguity and dtype before dispatch.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// bf16 <-> f32 (bit-level; bf16 is the high 16 bits of an IEEE f32)
+// ---------------------------------------------------------------------------
+
+static inline float bf16_to_f32(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+static inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, sizeof(bits));
+  if ((bits & 0x7fffffffu) > 0x7f800000u) {  // NaN: quiet, keep sign
+    return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+  }
+  // round-to-nearest-even on the dropped 16 bits
+  uint32_t lsb = (bits >> 16) & 1u;
+  bits += 0x7fffu + lsb;
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+// fp16 (IEEE binary16) <-> f32, bit-level (reference half.cc:20-80 role)
+static inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1fu;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;  // +-0
+    } else {  // subnormal: value = man * 2^-24 = (1+frac) * 2^(-14-shift)
+      int shift = 0;
+      while (!(man & 0x400u)) { man <<= 1; ++shift; }
+      man &= 0x3ffu;
+      bits = sign | ((127 - 14 - shift) << 23) | (man << 13);
+    }
+  } else if (exp == 0x1fu) {
+    bits = sign | 0x7f800000u | (man << 13);  // inf/NaN
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+static inline uint16_t f32_to_f16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, sizeof(bits));
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xffu) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (exp >= 0x1f) {  // overflow / inf / NaN
+    if (((bits & 0x7f800000u) == 0x7f800000u) && man) {
+      return static_cast<uint16_t>(sign | 0x7e00u);  // NaN
+    }
+    return static_cast<uint16_t>(sign | 0x7c00u);    // inf
+  }
+  if (exp <= 0) {  // subnormal or underflow to zero
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    man |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t mid = 1u << (shift - 1);
+    if (rem > mid || (rem == mid && (half & 1u))) ++half;
+    return static_cast<uint16_t>(sign | half);
+  }
+  uint32_t half = sign | (static_cast<uint32_t>(exp) << 10) | (man >> 13);
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1u))) ++half;
+  return static_cast<uint16_t>(half);
+}
+
+// ---------------------------------------------------------------------------
+// widen-add-narrow reduction steps (ring reduce-scatter inner loop)
+// dst += src elementwise, accumulating in f32, storing narrow.
+// ---------------------------------------------------------------------------
+
+void hvd_add_bf16(uint16_t* dst, const uint16_t* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = f32_to_bf16(bf16_to_f32(dst[i]) + bf16_to_f32(src[i]));
+  }
+}
+
+void hvd_add_f16(uint16_t* dst, const uint16_t* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = f32_to_f16(f16_to_f32(dst[i]) + f16_to_f32(src[i]));
+  }
+}
+
+void hvd_add_f32(float* dst, const float* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+void hvd_add_f64(double* dst, const double* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+// ---------------------------------------------------------------------------
+// in-place scale (pre/postscale application; reference ScaleBuffer)
+// ---------------------------------------------------------------------------
+
+void hvd_scale_bf16(uint16_t* buf, double factor, size_t n) {
+  const float f = static_cast<float>(factor);
+  for (size_t i = 0; i < n; ++i) {
+    buf[i] = f32_to_bf16(bf16_to_f32(buf[i]) * f);
+  }
+}
+
+void hvd_scale_f16(uint16_t* buf, double factor, size_t n) {
+  const float f = static_cast<float>(factor);
+  for (size_t i = 0; i < n; ++i) {
+    buf[i] = f32_to_f16(f16_to_f32(buf[i]) * f);
+  }
+}
+
+void hvd_scale_f32(float* buf, double factor, size_t n) {
+  const float f = static_cast<float>(factor);
+  for (size_t i = 0; i < n; ++i) buf[i] *= f;
+}
+
+void hvd_scale_f64(double* buf, double factor, size_t n) {
+  for (size_t i = 0; i < n; ++i) buf[i] *= factor;
+}
+
+// ---------------------------------------------------------------------------
+// Adasum fused segment kernels (reference adasum.h:194-450): one pass for
+// dot(a,b), ||a||^2, ||b||^2 with f64 accumulation, and the combine
+// a' = ca*a + cb*b.
+// ---------------------------------------------------------------------------
+
+void hvd_dot3_f32(const float* a, const float* b, size_t n, double* out3) {
+  double dot = 0.0, na = 0.0, nb = 0.0;
+  for (size_t i = 0; i < n; ++i) {
+    const double x = a[i], y = b[i];
+    dot += x * y;
+    na += x * x;
+    nb += y * y;
+  }
+  out3[0] = dot; out3[1] = na; out3[2] = nb;
+}
+
+void hvd_dot3_f64(const double* a, const double* b, size_t n, double* out3) {
+  double dot = 0.0, na = 0.0, nb = 0.0;
+  for (size_t i = 0; i < n; ++i) {
+    const double x = a[i], y = b[i];
+    dot += x * y;
+    na += x * x;
+    nb += y * y;
+  }
+  out3[0] = dot; out3[1] = na; out3[2] = nb;
+}
+
+void hvd_combine_f32(float* a, const float* b, double ca, double cb,
+                     size_t n) {
+  const float fa = static_cast<float>(ca), fb = static_cast<float>(cb);
+  for (size_t i = 0; i < n; ++i) a[i] = fa * a[i] + fb * b[i];
+}
+
+void hvd_combine_f64(double* a, const double* b, double ca, double cb,
+                     size_t n) {
+  for (size_t i = 0; i < n; ++i) a[i] = ca * a[i] + cb * b[i];
+}
+
+// Sanity probe for the loader.
+int hvd_native_abi_version(void) { return 1; }
+
+}  // extern "C"

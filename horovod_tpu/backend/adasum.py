@@ -68,14 +68,24 @@ def _segment_bounds(sizes: List[int], lo: int,
 def _partial_triplets(a: np.ndarray, b: np.ndarray,
                       segs: List[Tuple[int, int, int]],
                       num_tensors: int) -> np.ndarray:
-    """Slice-local (dot, ||a||², ||b||²) partial sums per tensor, fp64."""
+    """Slice-local (dot, ||a||², ||b||²) partial sums per tensor, fp64.
+
+    The native kernel (``_native/native.cc`` hvd_dot3) matches the
+    reference's fused one-pass dot/norm loops (``adasum.h:101-140``)."""
+    from .. import _native
+
     t = np.zeros((num_tensors, 3), np.float64)
     for idx, lo, hi in segs:
-        av = a[lo:hi].astype(np.float64, copy=False)
-        bv = b[lo:hi].astype(np.float64, copy=False)
-        t[idx, 0] += float(av @ bv)
-        t[idx, 1] += float(av @ av)
-        t[idx, 2] += float(bv @ bv)
+        av, bv = a[lo:hi], b[lo:hi]
+        native = _native.dot3(av, bv)
+        if native is not None:
+            t[idx] += native
+            continue
+        av64 = av.astype(np.float64, copy=False)
+        bv64 = bv.astype(np.float64, copy=False)
+        t[idx, 0] += float(av64 @ bv64)
+        t[idx, 1] += float(av64 @ av64)
+        t[idx, 2] += float(bv64 @ bv64)
     return t
 
 
@@ -83,11 +93,19 @@ def _apply_combine(a: np.ndarray, b: np.ndarray,
                    segs: List[Tuple[int, int, int]],
                    triplets: np.ndarray) -> np.ndarray:
     """out = ca·a + cb·b per tensor segment, with full-tensor coefficients."""
+    from .. import _native
+
+    native_ok = _native.lib() is not None and a.dtype in (np.float32,
+                                                          np.float64)
     out = np.zeros_like(a)
     for idx, lo, hi in segs:
         dot, na2, nb2 = triplets[idx]
         ca = 1.0 - dot / (2.0 * na2) if na2 >= _NORMSQ_EPS else 1.0
         cb = 1.0 - dot / (2.0 * nb2) if nb2 >= _NORMSQ_EPS else 1.0
+        if native_ok:  # pre-copy is only useful as the in-place operand
+            out[lo:hi] = a[lo:hi]
+            if _native.combine_inplace(out[lo:hi], b[lo:hi], ca, cb):
+                continue
         out[lo:hi] = ca * a[lo:hi] + cb * b[lo:hi]
     return out
 

@@ -126,7 +126,13 @@ def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry],
 
 def _scale_inplace(buf: np.ndarray, factor: float, wide: np.dtype) -> None:
     """Scale, widening for low-precision dtypes (reference ScaleBuffer,
-    ``collective_operations.h:89-125`` widens fp16 through fp32)."""
+    ``collective_operations.h:89-125`` widens fp16 through fp32).  The
+    native kernel (``_native/native.cc``) does it in one pass; numpy
+    fallback needs temporaries."""
+    from .. import _native
+
+    if _native.scale_inplace(buf, factor):
+        return
     if buf.dtype == wide:
         buf *= factor
     else:
@@ -137,7 +143,12 @@ def _widen_add(chunk: np.ndarray, incoming: np.ndarray,
                wide: np.dtype) -> None:
     """chunk += incoming with wide-precision arithmetic: the wire carries
     NARROW values (half the bytes for bf16/fp16) and only the add widens —
-    the reference's custom MPI fp16 sum (``half.cc``) does exactly this."""
+    the reference's custom MPI fp16 sum (``half.cc``) does exactly this,
+    and ``_native/native.cc`` is our single-pass version of it."""
+    from .. import _native
+
+    if _native.add_inplace(chunk, incoming):
+        return
     if chunk.dtype == wide:
         chunk += incoming
     else:

@@ -1,0 +1,201 @@
+"""Spark integration: run a horovod_tpu job inside a Spark job's tasks.
+
+Role of the reference's ``horovod/spark/runner.py:195-303`` (``run``) and
+its driver/task services: Spark provides process placement (one task per
+slot); the driver collects each task's location, assigns host-major ranks
+by executor locality, and the tasks then run the user function under the
+normal horovod_tpu runtime (rendezvous + TCP mesh), exactly like workers
+spawned by ``hvdrun``.
+
+Differences from the reference: no mpirun/orted re-exec dance and no
+pickled-RPC service framework — each Spark task registers and fetches its
+rank table directly through the launcher's HMAC-signed rendezvous KV
+server (the secret rides the Spark closure, which Spark encrypts in
+transit, matching the reference's "Spark RPC communicates the key"
+approach — ``spark/runner.py:46-48``), and the user function runs in the
+task process itself.
+
+``import horovod_tpu.spark`` works without pyspark; ``run()`` accepts any
+SparkContext-shaped object (``parallelize(...).mapPartitionsWithIndex(...)
+.collect()``), which is also how tests drive it without a Spark install.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common import env as env_mod
+from ..common import secret as secret_mod
+from ..common.logging_util import get_logger
+from ..runner.hosts import HostInfo, get_host_assignments
+from ..runner.rendezvous import RendezvousServer
+
+log = get_logger("horovod_tpu.spark")
+
+_REG_SCOPE = "spark.reg"
+_ENV_SCOPE = "spark.env"
+_RESULT_SCOPE = "spark.result"
+
+
+def _default_spark_context():
+    try:
+        import pyspark
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "horovod_tpu.spark.run() needs an active SparkContext: pass "
+            "one via sc=, or install pyspark") from e
+    sc = pyspark.SparkContext._active_spark_context
+    if sc is None:  # pragma: no cover
+        raise RuntimeError("no active SparkContext; create one first")
+    return sc
+
+
+def _task_fn(index: int, fn: Callable, args: tuple, kwargs: dict,
+             rdv_addr: str, rdv_port: int, key: str, start_timeout: float,
+             extra_env: Dict[str, str]):
+    """Runs inside each Spark task (reference ``_task_fn``,
+    ``spark/runner.py:45-116``): register location, wait for the rank
+    table, run the user fn under the horovod_tpu runtime."""
+    import socket
+
+    # The key arrives via the Spark closure; export before any rendezvous
+    # traffic so every request is signed.
+    os.environ[env_mod.HOROVOD_SECRET_KEY] = key
+    from ..transport.store import HTTPStoreClient
+
+    store = HTTPStoreClient(rdv_addr, rdv_port)
+    store.set(_REG_SCOPE, str(index), socket.gethostname().encode())
+
+    got = store.wait(_ENV_SCOPE, [str(index)], timeout=start_timeout)
+    env = json.loads(got[str(index)].decode())
+    os.environ.update({k: str(v) for k, v in env.items()})
+    os.environ.update({k: str(v) for k, v in extra_env.items()})
+
+    result = fn(*args, **kwargs)
+    store.set(_RESULT_SCOPE, str(index), _dumps(result))
+    return index
+
+
+def _dumps(obj) -> bytes:
+    try:
+        import cloudpickle as pickler
+    except ImportError:  # pragma: no cover
+        import pickle as pickler
+    return pickler.dumps(obj)
+
+
+def _loads(blob: bytes):
+    try:
+        import cloudpickle as pickler
+    except ImportError:  # pragma: no cover
+        import pickle as pickler
+    return pickler.loads(blob)
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: Optional[int] = None, sc=None,
+        extra_env: Optional[Dict[str, str]] = None,
+        start_timeout: float = 120.0,
+        stdout=None, stderr=None, verbose: int = 1) -> List[Any]:
+    """Run ``fn`` on ``num_proc`` Spark tasks as one horovod_tpu job;
+    returns per-rank results ordered by rank (reference
+    ``horovod.spark.run``, ``spark/runner.py:195-301``)."""
+    sc = sc or _default_spark_context()
+    if num_proc is None:
+        num_proc = int(sc.defaultParallelism)
+    kwargs = kwargs or {}
+
+    key = secret_mod.ensure_job_secret()
+    server = RendezvousServer(bind_addr="0.0.0.0",
+                              job_secret=key.encode())
+    port = server.start()
+    from ..transport.tcp import _default_advertise_addr
+
+    rdv_addr = _default_advertise_addr()
+
+    # Assignment thread (reference Coordinator role): once every task has
+    # registered its hostname, compute host-major ranks and publish each
+    # task's env — the Spark job is already running by then, so this must
+    # happen concurrently with collect().
+    assign_err: List[BaseException] = []
+
+    def _assign():
+        try:
+            deadline = time.monotonic() + start_timeout
+            hostnames: Dict[int, str] = {}
+            while len(hostnames) < num_proc:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"only {len(hostnames)}/{num_proc} Spark tasks "
+                        f"registered within {start_timeout}s")
+                for i in range(num_proc):
+                    if i not in hostnames:
+                        val = server.get(_REG_SCOPE, str(i))
+                        if val is not None:
+                            hostnames[i] = val.decode()
+                time.sleep(0.05)
+
+            by_host: Dict[str, List[int]] = {}
+            for i in range(num_proc):
+                by_host.setdefault(hostnames[i], []).append(i)
+            hosts = [HostInfo(h, len(idxs)) for h, idxs in by_host.items()]
+            slots = get_host_assignments(hosts, num_proc)
+            server.publish_slots([{
+                "hostname": s.hostname, "rank": s.rank,
+                "local_rank": s.local_rank, "cross_rank": s.cross_rank,
+                "size": s.size, "local_size": s.local_size,
+                "cross_size": s.cross_size,
+            } for s in slots])
+            # slot i of a host ↔ i-th registered task on that host
+            for slot in slots:
+                index = by_host[slot.hostname][slot.local_rank]
+                env = dict(slot.to_env())
+                env.update({
+                    env_mod.HOROVOD_RENDEZVOUS_ADDR: rdv_addr,
+                    env_mod.HOROVOD_RENDEZVOUS_PORT: str(port),
+                    env_mod.HOROVOD_CONTROLLER: "tcp",
+                })
+                server.set(_ENV_SCOPE, str(index),
+                           json.dumps(env).encode())
+        except BaseException as e:  # noqa: BLE001 — surfaced after collect
+            assign_err.append(e)
+
+    assigner = threading.Thread(target=_assign, daemon=True,
+                                name="hvd-spark-assign")
+    assigner.start()
+
+    mapper = _make_mapper(fn, args, kwargs, rdv_addr, port, key,
+                          start_timeout, dict(extra_env or {}))
+    try:
+        indices = sc.parallelize(range(num_proc), num_proc) \
+            .mapPartitionsWithIndex(mapper).collect()
+        if assign_err:
+            raise assign_err[0]
+        if sorted(indices) != list(range(num_proc)):
+            raise RuntimeError(f"Spark job lost tasks: got {indices}")
+        # Results come back rank-ordered: map index → rank via the
+        # published env table.
+        by_rank: Dict[int, Any] = {}
+        for i in range(num_proc):
+            env = json.loads(server.get(_ENV_SCOPE, str(i)).decode())
+            blob = server.get(_RESULT_SCOPE, str(i))
+            by_rank[int(env[env_mod.HOROVOD_RANK])] = _loads(blob)
+        return [by_rank[r] for r in range(num_proc)]
+    finally:
+        server.stop()
+
+
+def _make_mapper(fn, args, kwargs, rdv_addr, port, key, start_timeout,
+                 extra_env):
+    """Top-level closure factory (reference ``_make_mapper``,
+    ``spark/runner.py:118-125``) — keeps the lambda cloudpickle-friendly."""
+
+    def _mapper(index, _iterator):
+        yield _task_fn(index, fn, args, kwargs, rdv_addr, port, key,
+                       start_timeout, extra_env)
+
+    return _mapper

@@ -1,0 +1,70 @@
+"""A minimal SparkContext stand-in for testing horovod_tpu.spark.
+
+Implements only the surface ``spark.run`` touches —
+``parallelize(seq, n).mapPartitionsWithIndex(f).collect()`` plus
+``defaultParallelism`` — executing every partition CONCURRENTLY in its own
+spawned subprocess, like real Spark executors (hvd.init must see isolated
+processes)."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from typing import Any, Callable, List
+
+import cloudpickle
+
+_ctx = mp.get_context("spawn")
+
+
+def _part_runner(conn, blob):
+    f, index, chunk = cloudpickle.loads(blob)
+    try:
+        out = ("ok", list(f(index, iter(chunk))))
+    except BaseException as e:  # noqa: BLE001 — marshalled to driver
+        out = ("err", repr(e))
+    conn.send_bytes(cloudpickle.dumps(out))
+    conn.close()
+
+
+class FakeRDD:
+    def __init__(self, chunks: List[list]):
+        self._chunks = chunks
+        self._fn = None
+
+    def mapPartitionsWithIndex(self, f: Callable) -> "FakeRDD":
+        rdd = FakeRDD(self._chunks)
+        rdd._fn = f
+        return rdd
+
+    def collect(self) -> List[Any]:
+        assert self._fn is not None
+        procs = []
+        for i, chunk in enumerate(self._chunks):
+            parent, child = _ctx.Pipe()
+            p = _ctx.Process(
+                target=_part_runner,
+                args=(child, cloudpickle.dumps((self._fn, i, chunk))),
+                daemon=True)
+            p.start()
+            child.close()
+            procs.append((p, parent))
+        results: List[Any] = []
+        for p, parent in procs:
+            status, value = cloudpickle.loads(parent.recv_bytes())
+            p.join(timeout=30)
+            if status != "ok":
+                raise RuntimeError(f"spark task failed: {value}")
+            results.extend(value)
+        return results
+
+
+class FakeSparkContext:
+    def __init__(self, default_parallelism: int = 2):
+        self.defaultParallelism = default_parallelism
+
+    def parallelize(self, seq, numSlices: int) -> FakeRDD:
+        data = list(seq)
+        chunks = [[] for _ in range(numSlices)]
+        for i, item in enumerate(data):
+            chunks[i % numSlices].append(item)
+        return FakeRDD(chunks)

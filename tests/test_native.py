@@ -1,0 +1,148 @@
+"""Native kernel tests: bit-exact parity with the numpy fallback paths
+(reference analog: half.cc conversions and adasum.h fused loops are the
+C++ twins of these)."""
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from horovod_tpu import _native
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = _native.lib()
+    if lib is None:
+        pytest.skip("native kernels unavailable (no compiler?)")
+    return lib
+
+
+def test_builds_and_probes(native_lib):
+    assert native_lib.hvd_native_abi_version() == 1
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_add_inplace_wide(native_lib, dtype):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal(1013).astype(dtype)
+    b = rng.standard_normal(1013).astype(dtype)
+    exp = a + b
+    assert _native.add_inplace(a, b)
+    np.testing.assert_array_equal(a, exp)
+
+
+@pytest.mark.parametrize("dtype", [ml_dtypes.bfloat16, np.float16])
+def test_add_inplace_narrow_matches_widen_add(native_lib, dtype):
+    """Narrow adds must equal numpy's widen-add-narrow (round-to-nearest-
+    even both ways), including halfway-rounding cases."""
+    rng = np.random.default_rng(1)
+    a32 = rng.standard_normal(4096).astype(np.float32)
+    b32 = rng.standard_normal(4096).astype(np.float32)
+    a = a32.astype(dtype)
+    b = b32.astype(dtype)
+    exp = (a.astype(np.float32) + b.astype(np.float32)).astype(dtype)
+    got = a.copy()
+    assert _native.add_inplace(got, b)
+    np.testing.assert_array_equal(got.view(np.uint16), exp.view(np.uint16))
+
+
+@pytest.mark.parametrize("dtype", [ml_dtypes.bfloat16, np.float16,
+                                   np.float32, np.float64])
+def test_scale_inplace(native_lib, dtype):
+    rng = np.random.default_rng(2)
+    buf = rng.standard_normal(777).astype(np.float32).astype(dtype)
+    exp = (buf.astype(np.float32) * np.float32(0.125)).astype(dtype)
+    got = buf.copy()
+    assert _native.scale_inplace(got, 0.125)
+    if np.dtype(dtype).itemsize == 2:
+        np.testing.assert_array_equal(got.view(np.uint16),
+                                      exp.view(np.uint16))
+    else:
+        np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+
+def test_narrow_special_values(native_lib):
+    """inf/nan/zero survive the bit-level conversions."""
+    for dtype in (ml_dtypes.bfloat16, np.float16):
+        a = np.array([np.inf, -np.inf, 0.0, -0.0, np.nan, 1.0],
+                     dtype=dtype)
+        b = np.array([1.0, 1.0, 0.0, 0.0, 1.0, np.inf], dtype=dtype)
+        got = a.copy()
+        assert _native.add_inplace(got, b)
+        exp = (a.astype(np.float32) + b.astype(np.float32)).astype(dtype)
+        # NaN payloads may differ; compare NaN-ness then values elsewhere
+        g32, e32 = got.astype(np.float32), exp.astype(np.float32)
+        assert np.array_equal(np.isnan(g32), np.isnan(e32))
+        mask = ~np.isnan(e32)
+        np.testing.assert_array_equal(g32[mask], e32[mask])
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_dot3(native_lib, dtype):
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal(511).astype(dtype)
+    b = rng.standard_normal(511).astype(dtype)
+    out = _native.dot3(a, b)
+    assert out is not None
+    a64, b64 = a.astype(np.float64), b.astype(np.float64)
+    np.testing.assert_allclose(
+        out, [a64 @ b64, a64 @ a64, b64 @ b64], rtol=1e-12)
+
+
+def test_combine_inplace(native_lib):
+    rng = np.random.default_rng(4)
+    a = rng.standard_normal(129).astype(np.float32)
+    b = rng.standard_normal(129).astype(np.float32)
+    exp = np.float32(0.75) * a + np.float32(-0.25) * b
+    got = a.copy()
+    assert _native.combine_inplace(got, b, 0.75, -0.25)
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+
+def test_disabled_by_env(monkeypatch):
+    """HOROVOD_DISABLE_NATIVE must force the numpy fallback."""
+    import importlib
+
+    import horovod_tpu._native as nat
+
+    monkeypatch.setenv("HOROVOD_DISABLE_NATIVE", "1")
+    fresh = importlib.reload(nat)
+    try:
+        assert fresh.lib() is None
+        a = np.ones(4, np.float32)
+        assert not fresh.add_inplace(a, a)
+    finally:
+        monkeypatch.delenv("HOROVOD_DISABLE_NATIVE")
+        importlib.reload(nat)
+
+
+def test_non_contiguous_falls_back(native_lib):
+    a = np.ones((4, 4), np.float32)[:, 0]
+    b = np.ones(4, np.float32)
+    assert not _native.add_inplace(a, b)
+
+
+def test_fp16_subnormal_exactness(native_lib):
+    """Subnormal fp16 (|x| < 2^-14) must convert exactly — the initial
+    implementation halved them (exponent off by one)."""
+    bits = np.array([0x0001, 0x0200, 0x03ff, 0x8001, 0x83ff, 0x0400],
+                    dtype=np.uint16)
+    a = bits.view(np.float16)
+    b = np.zeros_like(a)
+    got = a.copy()
+    assert _native.add_inplace(got, b)  # x + 0 round-trips exactly
+    np.testing.assert_array_equal(got.view(np.uint16), bits)
+    # and a subnormal sum that stays subnormal
+    x = np.full(8, 2.98023e-08, np.float16)   # smallest subnormal
+    y = x.copy()
+    assert _native.add_inplace(y, x)
+    exp = (x.astype(np.float32) * 2).astype(np.float16)
+    np.testing.assert_array_equal(y.view(np.uint16), exp.view(np.uint16))
+
+
+def test_add_rejects_mismatched_sizes(native_lib):
+    a = np.ones(8, np.float32)
+    b = np.ones(4, np.float32)
+    assert not _native.add_inplace(a, b)
+    assert _native.dot3(a, b) is None
+    assert not _native.combine_inplace(a, b, 1.0, 1.0)
