@@ -1,18 +1,20 @@
-"""Headline benchmark: ResNet-50 synthetic training throughput (images/sec).
+"""Headline benchmark: ResNet-50 synthetic training throughput + MFU.
 
 Mirror of the reference's synthetic benchmark
 (`examples/tensorflow2/tensorflow2_synthetic_benchmark.py`: ResNet-50,
 synthetic ImageNet-shaped batches, warmup then timed iterations, reports
-images/sec).  Runs on whatever accelerator is attached (the driver gives one
-TPU chip); falls back to CPU with a tiny config so the script always
+images/sec).  Runs on whatever accelerator is attached (the driver gives
+one TPU chip); falls back to CPU with a tiny config so the script always
 produces its JSON line.
 
-``vs_baseline``: the only absolute throughput the reference publishes is
-`docs/benchmarks.rst:32-43` — 1656.82 images/sec on 16 Pascal GPUs
-(ResNet-101 bs=64) = 103.55 images/sec/GPU.  BASELINE.md's per-chip metric
-is measured against that per-device figure.
+``vs_baseline`` is **MFU** — measured FLOPs/sec divided by the chip's peak
+(VERDICT round 1: the old denominator was a 2016 Pascal GPU figure, a
+vanity comparison).  FLOPs/step come from XLA's own cost model
+(``compiled.cost_analysis()['flops']``, multiply-add = 2 ops, the same
+convention as the peak numbers), with an analytic ResNet-50 fallback.
+The reference's published numbers remain in BASELINE.md for context.
 
-Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
@@ -20,7 +22,26 @@ from __future__ import annotations
 import json
 import time
 
-REFERENCE_PER_DEVICE_IMG_PER_SEC = 1656.82 / 16  # docs/benchmarks.rst:32-43
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,   # v5e device_kind is "TPU v5 lite"
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+}
+
+# Analytic fallback: ResNet-50 forward ~4.09 GMACs at 224x224 = 8.2 GFLOPs
+# (MAC=2); training ~3x forward.
+_RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9
+
+
+def _peak_for(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return None
 
 
 def main() -> None:
@@ -57,29 +78,55 @@ def main() -> None:
     step = make_sharded_train_step(model, tx, mesh, has_batch_stats=True,
                                    donate=True)
 
+    # AOT-compile once: the same executable serves the timed loop AND the
+    # FLOPs measurement (no second trace/compile).
+    compiled = step.lower(state, batch).compile()
+    n_dev = len(jax.devices())
+    # Everything below is PER-DEVICE: cost_analysis describes the
+    # SPMD-partitioned per-device module already, while the analytic
+    # count covers the global batch and must be divided down.
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        flops_per_step_dev = float(ca["flops"])
+        flops_source = "xla_cost_analysis"
+    except Exception:  # noqa: BLE001 — backend without cost model
+        flops_per_step_dev = _RESNET50_TRAIN_FLOPS_PER_IMG * batch_size \
+            * (image_size / 224) ** 2 / n_dev
+        flops_source = "analytic"
+
     # Sync points use device_get of the step's loss, not block_until_ready:
     # the attached TPU backend can report buffers ready before remote
     # execution finishes, but a host transfer of the final loss cannot
     # complete early — it transitively waits on every chained step.
     for _ in range(warmup):
-        state, loss = step(state, batch)
+        state, loss = compiled(state, batch)
     float(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, loss = step(state, batch)
+        state, loss = compiled(state, batch)
     final_loss = float(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
 
-    img_per_sec = batch_size * iters / dt
-    n_dev = len(jax.devices())
+    img_per_sec = batch_size * iters / dt / n_dev
+    flops_per_sec = flops_per_step_dev * iters / dt
+    peak = _peak_for(jax.devices()[0]) if on_tpu else None
+    mfu = round(flops_per_sec / peak, 4) if peak else 0.0
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
-        "value": round(img_per_sec / n_dev, 2),
+        "value": round(img_per_sec, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_per_sec / n_dev /
-                             REFERENCE_PER_DEVICE_IMG_PER_SEC, 3),
+        "vs_baseline": mfu,
+        "mfu": mfu,
+        "tflops_per_sec_per_chip": round(flops_per_sec / 1e12, 2),
+        "flops_per_step_per_device": flops_per_step_dev,
+        "flops_source": flops_source,
+        "batch_size": batch_size,
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
     }))
 
 

@@ -1,0 +1,159 @@
+"""PyTorch ImageNet ResNet-50 training (port of reference
+``examples/pytorch/pytorch_imagenet_resnet50.py`` — BASELINE config #3).
+
+The full reference recipe: DistributedOptimizer with WFBP hooks, linear
+LR scaling with warmup, rank-0-only checkpointing fanned out through
+``broadcast_parameters``/``broadcast_optimizer_state``, metric averaging
+via allreduce.  Without an ImageNet directory (``--train-dir``) it runs on
+synthetic data so the script is exercisable anywhere.
+
+Run: ``hvdrun -np 4 python examples/pytorch/pytorch_imagenet_resnet50.py
+--train-dir /data/imagenet/train --epochs 90``
+"""
+
+import argparse
+import math
+import os
+
+import horovod_tpu.torch as hvd
+
+
+def build_model(name: str):
+    import torch
+
+    try:
+        import torchvision.models as models
+
+        return getattr(models, name)()
+    except ImportError:
+        return torch.nn.Sequential(
+            torch.nn.Conv2d(3, 32, 3, stride=2), torch.nn.ReLU(),
+            torch.nn.Conv2d(32, 64, 3, stride=2), torch.nn.ReLU(),
+            torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+            torch.nn.Linear(64, 1000))
+
+
+def make_loader(args, torch):
+    if args.train_dir and os.path.isdir(args.train_dir):
+        import torchvision.datasets as datasets
+        import torchvision.transforms as transforms
+
+        dataset = datasets.ImageFolder(
+            args.train_dir,
+            transforms.Compose([
+                transforms.RandomResizedCrop(224),
+                transforms.RandomHorizontalFlip(),
+                transforms.ToTensor(),
+                transforms.Normalize((0.485, 0.456, 0.406),
+                                     (0.229, 0.224, 0.225)),
+            ]))
+        # shard the dataset across ranks (reference DistributedSampler use)
+        sampler = torch.utils.data.distributed.DistributedSampler(
+            dataset, num_replicas=hvd.size(), rank=hvd.rank())
+        return torch.utils.data.DataLoader(
+            dataset, batch_size=args.batch_size, sampler=sampler,
+            num_workers=args.workers), sampler
+    # synthetic fallback: fixed random batches, rank-seeded
+    g = torch.Generator().manual_seed(1234 + hvd.rank())
+    batches = [(torch.randn(args.batch_size, 3, args.image_size,
+                            args.image_size, generator=g),
+                torch.randint(0, 1000, (args.batch_size,), generator=g))
+               for _ in range(args.synthetic_batches)]
+    return batches, None
+
+
+def adjust_lr(optimizer, epoch, batch_idx, loader_len, args):
+    """Linear scaling + warmup (reference pytorch_imagenet_resnet50.py)."""
+    if epoch < args.warmup_epochs:
+        progress = (batch_idx + 1 + epoch * loader_len) / \
+            (args.warmup_epochs * loader_len)
+        lr_adj = progress * (hvd.size() - 1) / hvd.size() + 1 / hvd.size()
+    else:
+        lr_adj = 10 ** (-sum(epoch >= e for e in (30, 60, 80)))
+    for group in optimizer.param_groups:
+        group["lr"] = args.base_lr * hvd.size() * lr_adj
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train-dir", default=None)
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--base-lr", type=float, default=0.0125)
+    p.add_argument("--warmup-epochs", type=int, default=5)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=5e-5)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--synthetic-batches", type=int, default=8)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--checkpoint-format",
+                   default="checkpoint-{epoch}.pth.tar")
+    args = p.parse_args()
+
+    hvd.init()
+    import torch
+    import torch.nn.functional as F
+
+    model = build_model(args.model)
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.base_lr * hvd.size(),
+                                momentum=args.momentum,
+                                weight_decay=args.wd)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=(hvd.Compression.fp16 if args.fp16_allreduce
+                     else hvd.Compression.none))
+
+    # resume-from-checkpoint on rank 0, then fan out (reference idiom)
+    resume_epoch = 0
+    if hvd.rank() == 0:
+        for epoch in range(args.epochs, 0, -1):
+            path = args.checkpoint_format.format(epoch=epoch)
+            if os.path.exists(path):
+                ckpt = torch.load(path, weights_only=True)
+                model.load_state_dict(ckpt["model"])
+                optimizer.load_state_dict(ckpt["optimizer"])
+                resume_epoch = epoch
+                break
+    resume_epoch = int(hvd.broadcast_object(resume_epoch, 0,
+                                            name="resume_epoch"))
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    loader, sampler = make_loader(args, torch)
+    loader_len = len(loader)
+
+    for epoch in range(resume_epoch, args.epochs):
+        model.train()
+        if sampler is not None:
+            sampler.set_epoch(epoch)
+        epoch_loss, epoch_acc, seen = 0.0, 0.0, 0
+        for batch_idx, (data, target) in enumerate(loader):
+            adjust_lr(optimizer, epoch, batch_idx, loader_len, args)
+            optimizer.zero_grad()
+            output = model(data)
+            loss = F.cross_entropy(output, target)
+            loss.backward()
+            optimizer.step()
+            epoch_loss += loss.item() * len(target)
+            epoch_acc += (output.argmax(1) == target).float().sum().item()
+            seen += len(target)
+
+        # metric averaging across ranks (reference Metric class role)
+        import numpy as np
+
+        loss_avg, acc_avg = np.asarray(hvd.allreduce(
+            np.array([epoch_loss / seen, epoch_acc / seen]),
+            op=hvd.Average, name=f"metrics.{epoch}"))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {loss_avg:.4f} "
+                  f"acc {acc_avg:.4f}", flush=True)
+            torch.save({"model": model.state_dict(),
+                        "optimizer": optimizer.state_dict()},
+                       args.checkpoint_format.format(epoch=epoch + 1))
+
+
+if __name__ == "__main__":
+    main()

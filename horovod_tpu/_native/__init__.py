@@ -94,17 +94,27 @@ def lib() -> Optional[ctypes.CDLL]:
                        or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
         if needs_build and not _build():
             return None
-        try:
-            # AttributeError covers a stale .so missing newer symbols —
-            # native must degrade to numpy, never crash a collective.
-            cdll = _bind(ctypes.CDLL(_SO))
-            if cdll.hvd_native_abi_version() != 1:
-                raise OSError("ABI version mismatch")
-            _lib = cdll
-        except (OSError, AttributeError) as e:
-            log.warning("native kernel load failed (%s); using numpy", e)
-            _lib = None
+        _lib = _try_load()
+        if _lib is None and not needs_build:
+            # The existing .so may be foreign (wrong arch/glibc from a
+            # copied checkout or prebuilt wheel); one rebuild attempt
+            # before giving up on native for the process lifetime.
+            if _build():
+                _lib = _try_load()
     return _lib
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    try:
+        # AttributeError covers a stale .so missing newer symbols —
+        # native must degrade to numpy, never crash a collective.
+        cdll = _bind(ctypes.CDLL(_SO))
+        if cdll.hvd_native_abi_version() != 1:
+            raise OSError("ABI version mismatch")
+        return cdll
+    except (OSError, AttributeError) as e:
+        log.warning("native kernel load failed (%s); using numpy", e)
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,25 @@
+"""Shared object (de)serialization: cloudpickle when available (closures,
+lambdas — the launcher/Spark/object-collective payloads need it), stdlib
+pickle otherwise.  One definition for every module that previously carried
+its own try/except copy."""
+
+from __future__ import annotations
+
+
+def _pickler():
+    try:
+        import cloudpickle
+
+        return cloudpickle
+    except ImportError:  # pragma: no cover
+        import pickle
+
+        return pickle
+
+
+def dumps(obj) -> bytes:
+    return _pickler().dumps(obj)
+
+
+def loads(blob: bytes):
+    return _pickler().loads(blob)

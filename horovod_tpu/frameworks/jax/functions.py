@@ -9,7 +9,6 @@ fabric by encoding them as uint8 tensors.
 from __future__ import annotations
 
 import io
-import pickle
 from typing import Any, List, Optional
 
 import numpy as np
@@ -17,10 +16,7 @@ import numpy as np
 from . import ops
 from .basics import rank, size
 
-try:
-    import cloudpickle as _pickler
-except ImportError:  # pragma: no cover
-    _pickler = pickle
+from ...common import pickling as _pickler
 
 
 def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:

@@ -1,0 +1,165 @@
+"""MXNet binding.
+
+Role of the reference's ``horovod/mxnet`` (``mpi_ops.py:1-309``,
+``__init__.py:1-195``): ``allreduce/allgather/broadcast/alltoall`` on
+NDArrays, ``DistributedOptimizer`` wrapping ``optimizer.update``,
+``DistributedTrainer`` for Gluon, ``broadcast_parameters``.  Like the TF
+and Torch compatibility surfaces here, tensors bridge via numpy into the
+shared enqueue API — there is no engine-async C++ extension (the reference
+needed one to order collectives against MXNet's dependency engine; a
+synchronous numpy bridge is already ordered).
+
+MXNet is EOL upstream and not installed in most environments; everything
+imports lazily so this module loads without it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..jax.basics import (
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..jax.ops import Adasum, Average, Sum, barrier, join
+from ..jax import ops as _core_ops
+
+
+def _mx():
+    import mxnet
+
+    return mxnet
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    if hasattr(tensor, "asnumpy"):
+        return tensor.asnumpy()
+    return np.asarray(tensor)
+
+
+def _from_numpy(arr: np.ndarray, like=None):
+    mx = _mx()
+    ctx = like.context if like is not None and hasattr(like, "context") \
+        else None
+    return mx.nd.array(arr, ctx=ctx)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[str] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    out = _core_ops.allreduce(
+        _to_numpy(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    return _from_numpy(np.asarray(out), like=tensor)
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: Optional[str] = None):
+    """In-place flavor (reference ``allreduce_``)."""
+    out = _core_ops.allreduce(_to_numpy(tensor), average=average,
+                              name=name, op=op)
+    tensor[:] = _from_numpy(np.asarray(out), like=tensor)
+    return tensor
+
+
+def allgather(tensor, name: Optional[str] = None):
+    out = _core_ops.allgather(_to_numpy(tensor), name=name)
+    return _from_numpy(np.asarray(out), like=tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    out = _core_ops.broadcast(_to_numpy(tensor), root_rank, name=name)
+    return _from_numpy(np.asarray(out), like=tensor)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None):
+    out = _core_ops.broadcast(_to_numpy(tensor), root_rank, name=name)
+    tensor[:] = _from_numpy(np.asarray(out), like=tensor)
+    return tensor
+
+
+def alltoall(tensor, splits: Optional[List[int]] = None,
+             name: Optional[str] = None):
+    out = _core_ops.alltoall(_to_numpy(tensor), splits=splits, name=name)
+    return _from_numpy(np.asarray(out), like=tensor)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a Gluon ``ParameterDict`` or plain dict of NDArrays
+    (reference ``mxnet/functions.py broadcast_parameters``)."""
+    items = params.items() if hasattr(params, "items") else params
+    for name, p in sorted(items):
+        data = p.data() if hasattr(p, "data") else p
+        out = broadcast(data, root_rank, name=f"bcast.{name}")
+        if hasattr(p, "set_data"):
+            p.set_data(out)
+        else:
+            data[:] = out
+
+
+class DistributedOptimizer:
+    """Wraps ``mxnet.optimizer.Optimizer``: allreduce the gradient before
+    every ``update`` (reference ``mxnet/__init__.py DistributedOptimizer``)."""
+
+    def __init__(self, optimizer, op: str = Average):
+        self._opt = optimizer
+        self._op = op
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def _reduce(self, index, grad):
+        if size() == 1:
+            return grad
+        return allreduce(grad, op=self._op, name=f"grad.{index}")
+
+    def update(self, index, weight, grad, state):
+        self._opt.update(index, weight, self._reduce(index, grad), state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._opt.update_multi_precision(
+            index, weight, self._reduce(index, grad), state)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       op: str = Average):
+    """Gluon Trainer whose ``_allreduce_grads`` runs our collectives
+    (reference ``mxnet/__init__.py DistributedTrainer``)."""
+    mx = _mx()
+
+    class _Trainer(mx.gluon.Trainer):
+        def __init__(self):
+            super().__init__(params, optimizer,
+                             optimizer_params or {}, kvstore=None)
+            # LR scaling is the caller's business like the reference;
+            # the trainer only swaps the gradient reduction.
+
+        def _allreduce_grads(self):
+            if size() == 1:
+                return
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for grad in param.list_grad():
+                        allreduce_(grad, op=op, name=f"grad.{i}")
+
+    return _Trainer()
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "is_homogeneous",
+    "allreduce", "allreduce_", "allgather", "broadcast", "broadcast_",
+    "alltoall", "join", "barrier", "broadcast_parameters",
+    "DistributedOptimizer", "DistributedTrainer",
+    "Sum", "Average", "Adasum",
+]

@@ -178,6 +178,22 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
         synchronize_(h)
 
 
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    """Pickle-broadcast an arbitrary object (reference
+    ``torch/functions.py:186-224``)."""
+    from ..jax.functions import broadcast_object as _bo
+
+    return _bo(obj, root_rank=root_rank, name=name or "torch.bcast_obj")
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    """Gather one pickled object per rank (reference
+    ``torch/functions.py:227-257``)."""
+    from ..jax.functions import allgather_object as _ao
+
+    return _ao(obj, name=name or "torch.allgather_obj")
+
+
 def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     """Broadcast optimizer state tensors + hyperparameters from root
     (reference ``functions.py:62``: rebuilds the state dict as tensors)."""
@@ -377,6 +393,7 @@ __all__ = [
     "broadcast_", "broadcast_async_", "alltoall", "join", "barrier",
     "poll", "synchronize", "synchronize_",
     "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_object", "allgather_object",
     "Compression", "DistributedOptimizer",
     "Sum", "Average", "Adasum",
 ]

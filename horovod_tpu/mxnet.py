@@ -1,0 +1,5 @@
+"""`import horovod_tpu.mxnet as hvd` — reference-parity alias for the
+MXNet binding (reference exposes `horovod.mxnet`)."""
+
+from .frameworks.mxnet import *  # noqa: F401,F403
+from .frameworks.mxnet import __all__  # noqa: F401

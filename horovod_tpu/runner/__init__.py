@@ -26,11 +26,7 @@ def run(func, args: tuple = (), kwargs: Optional[dict] = None,
     Local-machine only (workers are subprocesses); ``timeout`` bounds total
     execution and is unlimited by default — user functions may train for
     hours.  For multi-host jobs use the ``hvdrun`` CLI's ssh path."""
-    try:
-        import cloudpickle as pickler
-    except ImportError:  # pragma: no cover
-        import pickle as pickler
-
+    from ..common import pickling as pickler
     from .hosts import get_host_assignments, parse_hosts
     from .launch import _is_local, _slot_env
     from .rendezvous import RendezvousServer

@@ -15,12 +15,8 @@ RESULT_SCOPE = "exec_result"
 
 
 def main() -> int:
-    try:
-        import cloudpickle as pickler
-    except ImportError:  # pragma: no cover
-        import pickle as pickler
-
     from ..common import env as env_mod
+    from ..common import pickling as pickler
     from ..transport.store import HTTPStoreClient
 
     addr = os.environ[env_mod.HOROVOD_RENDEZVOUS_ADDR]

@@ -80,33 +80,28 @@ def _task_fn(index: int, fn: Callable, args: tuple, kwargs: dict,
     return index
 
 
-def _dumps(obj) -> bytes:
-    try:
-        import cloudpickle as pickler
-    except ImportError:  # pragma: no cover
-        import pickle as pickler
-    return pickler.dumps(obj)
-
-
-def _loads(blob: bytes):
-    try:
-        import cloudpickle as pickler
-    except ImportError:  # pragma: no cover
-        import pickle as pickler
-    return pickler.loads(blob)
+from ..common.pickling import dumps as _dumps  # noqa: E402
+from ..common.pickling import loads as _loads  # noqa: E402
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         num_proc: Optional[int] = None, sc=None,
         extra_env: Optional[Dict[str, str]] = None,
-        start_timeout: float = 120.0,
-        stdout=None, stderr=None, verbose: int = 1) -> List[Any]:
+        start_timeout: float = 120.0) -> List[Any]:
     """Run ``fn`` on ``num_proc`` Spark tasks as one horovod_tpu job;
     returns per-rank results ordered by rank (reference
     ``horovod.spark.run``, ``spark/runner.py:195-301``)."""
     sc = sc or _default_spark_context()
     if num_proc is None:
         num_proc = int(sc.defaultParallelism)
+    elif num_proc > int(getattr(sc, "defaultParallelism", num_proc)):
+        # All tasks must run CONCURRENTLY (they form one collective job);
+        # over-subscribing deadlocks until start_timeout (reference
+        # validates executor capacity up front the same way).
+        raise ValueError(
+            f"num_proc={num_proc} exceeds the cluster's parallelism "
+            f"({sc.defaultParallelism}); a horovod_tpu Spark job needs "
+            "every task running at once")
     kwargs = kwargs or {}
 
     key = secret_mod.ensure_job_secret()

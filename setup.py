@@ -12,6 +12,15 @@ import subprocess
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+class BinaryDistribution(Distribution):
+    """The wheel ships a compiled .so: force a platform tag so pip never
+    installs an x86-64 build onto a foreign architecture."""
+
+    def has_ext_modules(self):
+        return True
 
 
 class build_py_with_native(build_py):
@@ -33,4 +42,5 @@ class build_py_with_native(build_py):
                 print(f"warning: native kernel build skipped: {e}")
 
 
-setup(cmdclass={"build_py": build_py_with_native})
+setup(cmdclass={"build_py": build_py_with_native},
+      distclass=BinaryDistribution)

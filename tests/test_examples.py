@@ -1,0 +1,68 @@
+"""Smoke-run every BASELINE example config under a real 2-process hvdrun
+launch with CI-sized knobs (BASELINE.md: "examples running unmodified" is
+the acceptance bar; reference CI runs its examples the same way)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hvdrun(np_, script_args, timeout=420, extra_cli=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TF_CPP_MIN_LOG_LEVEL="2")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", str(np_), *extra_cli, sys.executable, *script_args],
+        cwd=REPO_ROOT, text=True, capture_output=True, timeout=timeout,
+        env=env)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+def test_keras_mnist(tmp_path):
+    tf = pytest.importorskip("tensorflow")  # noqa: F841
+    out = _hvdrun(2, ["examples/keras/keras_mnist.py", "--epochs", "1"])
+    assert "FINAL rank0 loss=" in out
+
+
+def test_tensorflow2_synthetic_benchmark():
+    tf = pytest.importorskip("tensorflow")  # noqa: F841
+    out = _hvdrun(2, ["examples/tensorflow2/tensorflow2_synthetic_benchmark.py",
+                      "--num-iters", "1", "--num-warmup-batches", "1",
+                      "--num-batches-per-iter", "1", "--batch-size", "2",
+                      "--image-size", "32"])
+    assert "img/sec" in out.lower() or "images/sec" in out.lower()
+
+
+def test_pytorch_imagenet_resnet50(tmp_path):
+    torch = pytest.importorskip("torch")  # noqa: F841
+    out = _hvdrun(2, ["examples/pytorch/pytorch_imagenet_resnet50.py",
+                      "--epochs", "1", "--synthetic-batches", "2",
+                      "--image-size", "32", "--batch-size", "2",
+                      "--checkpoint-format",
+                      str(tmp_path / "ck-{epoch}.pth.tar")])
+    assert "epoch 0" in out
+
+
+def test_adasum_bert_pretraining():
+    out = _hvdrun(2, ["examples/adasum/adasum_bert_pretraining.py",
+                      "--steps", "3", "--batch-size", "2",
+                      "--seq-len", "16"])
+    assert "ADASUM BERT DONE" in out
+
+
+def test_elastic_tensorflow2_resnet50(tmp_path):
+    tf = pytest.importorskip("tensorflow")  # noqa: F841
+    discover = tmp_path / "discover.sh"
+    discover.write_text("#!/bin/sh\necho localhost:2\n")
+    discover.chmod(0o755)
+    out = _hvdrun(2, ["examples/elastic/tensorflow2_resnet50_elastic.py",
+                      "--batches", "6", "--commit-every", "3",
+                      "--batch-size", "2", "--image-size", "32"],
+                  extra_cli=["--min-np", "1",
+                             "--host-discovery-script", str(discover)])
+    assert "ELASTIC RESNET DONE" in out
