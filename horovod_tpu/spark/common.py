@@ -1,0 +1,100 @@
+"""Shared Spark-Estimator machinery: the Store abstraction and DataFrame
+→ numpy extraction.
+
+Role of the reference's ``spark/common/store.py`` (LocalFS/HDFS Store for
+checkpoints and intermediate data, ~504 LoC) and the Petastorm
+DataFrame-materialization pipeline in ``spark/common/util.py``.  The
+TPU-native slim-down: checkpoints go through a small Store (local
+filesystem implementation; the interface is the extension point for
+GCS/HDFS), and training data is extracted to numpy on the driver and
+shipped inside the task closure — honest for datasets that fit driver
+memory, which is the regime the in-repo tests and examples use.  A
+streaming (Petastorm-role) path is a documented extension, not an
+emulation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+
+class Store:
+    """Checkpoint/artifact store (reference ``store.py:32-153``)."""
+
+    def save_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def load_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Filesystem store rooted at ``prefix_path`` (reference
+    ``FilesystemStore``/``LocalStore``)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix = prefix_path
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def _full(self, path: str) -> str:
+        return os.path.join(self.prefix, path)
+
+    def save_bytes(self, path: str, data: bytes) -> None:
+        full = self._full(path)
+        os.makedirs(os.path.dirname(full) or ".", exist_ok=True)
+        tmp = full + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, full)
+
+    def load_bytes(self, path: str) -> bytes:
+        with open(self._full(path), "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._full(path))
+
+
+def extract_arrays(df, feature_cols: List[str],
+                   label_cols: Optional[List[str]]
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """DataFrame → (features, labels) numpy arrays; ``label_cols=None``
+    extracts features only (inference path — labels are never collected).
+
+    Accepts a pyspark DataFrame (``select(...).collect()`` of Rows), a
+    pandas DataFrame, or a plain ``(x, y)`` tuple of arrays (the test/
+    in-memory path)."""
+    if isinstance(df, tuple) and len(df) == 2:
+        x, y = df
+        return np.asarray(x), (np.asarray(y) if label_cols else None)
+    if hasattr(df, "select") and hasattr(df, "collect"):  # pyspark
+        cols = feature_cols + (label_cols or [])
+        rows = df.select(*cols).collect()
+        nf = len(feature_cols)
+        x = np.asarray([[row[i] for i in range(nf)] for row in rows])
+        if not label_cols:
+            return x, None
+        y = np.asarray([[row[nf + i] for i in range(len(label_cols))]
+                        for row in rows])
+        return x, y.squeeze(-1) if y.shape[-1] == 1 else y
+    if hasattr(df, "loc"):  # pandas
+        x = df[feature_cols].to_numpy()
+        if not label_cols:
+            return x, None
+        y = df[label_cols].to_numpy()
+        return x, y.squeeze(-1) if y.ndim > 1 and y.shape[-1] == 1 else y
+    raise TypeError(f"unsupported dataset type {type(df)!r}: expected a "
+                    "Spark DataFrame, pandas DataFrame, or (x, y) arrays")
+
+
+def shard(x: np.ndarray, y: np.ndarray, rank: int,
+          size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Rank's slice of the dataset (the reference shards via Petastorm row
+    groups; modulo striping keeps label distribution even)."""
+    return x[rank::size], y[rank::size]

@@ -1,0 +1,157 @@
+"""Spark ML-style Keras Estimator.
+
+Role of the reference's ``spark/keras/estimator.py:564`` (``KerasEstimator``
+→ ``KerasModel``): ``fit(df)`` runs distributed Keras training as a Spark
+job (one horovod_tpu rank per task, DistributedOptimizer, rank-0
+checkpointing through the Store) and returns a ``KerasModel`` transformer
+whose ``transform``/``predict`` applies the trained network.
+
+Slim-down vs the reference: no Spark ML ``Params``/pipeline base classes
+(works without pyspark installed — any SparkContext-shaped object drives
+the job) and data is extracted on the driver instead of streamed via
+Petastorm (see ``spark/common.py``).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..common.pickling import dumps, loads
+from . import run as spark_run
+from .common import LocalStore, Store, extract_arrays, shard
+
+
+def _train_task(model_blob: bytes, compile_kwargs: dict, x, y,
+                batch_size: int, epochs: int, verbose: int,
+                store: Optional[Store], ckpt_path: str):
+    """Runs on every Spark task: standard horovod_tpu Keras recipe
+    (reference ``spark/keras/remote.py`` role)."""
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    import keras
+
+    model = keras.models.model_from_json(model_blob.decode())
+    opt_cfg, loss, metrics = (compile_kwargs["optimizer"],
+                              compile_kwargs["loss"],
+                              compile_kwargs.get("metrics"))
+    optimizer = keras.optimizers.deserialize(opt_cfg)
+    model.compile(optimizer=hvd.DistributedOptimizer(optimizer),
+                  loss=loss, metrics=metrics)
+
+    sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
+    callbacks = [hvd.BroadcastGlobalVariablesCallback(0)]
+    history = model.fit(sx, sy, batch_size=batch_size, epochs=epochs,
+                        verbose=verbose, callbacks=callbacks)
+
+    weights = model.get_weights() if hvd.rank() == 0 else None
+    if hvd.rank() == 0 and store is not None:
+        buf = io.BytesIO()
+        np.savez(buf, *weights)
+        store.save_bytes(ckpt_path, buf.getvalue())
+    return {"weights": weights, "history": history.history}
+
+
+class KerasEstimator:
+    """``KerasEstimator(model=..., optimizer=..., loss=...).fit(df)``
+    (reference ``spark/keras/estimator.py`` surface)."""
+
+    def __init__(self, model=None, optimizer=None, loss=None, metrics=None,
+                 feature_cols: Optional[List[str]] = None,
+                 label_cols: Optional[List[str]] = None,
+                 batch_size: int = 32, epochs: int = 1,
+                 num_proc: Optional[int] = None,
+                 store: Optional[Store] = None,
+                 checkpoint_path: str = "keras_checkpoint.npz",
+                 verbose: int = 0, sc=None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = metrics
+        self.feature_cols = feature_cols or ["features"]
+        self.label_cols = label_cols or ["label"]
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store
+        self.checkpoint_path = checkpoint_path
+        self.verbose = verbose
+        self.sc = sc
+
+    def fit(self, df) -> "KerasModel":
+        import keras
+
+        x, y = extract_arrays(df, self.feature_cols, self.label_cols)
+        if self.num_proc and len(x) < self.num_proc:
+            raise ValueError(f"dataset has {len(x)} rows < "
+                             f"num_proc={self.num_proc}")
+        model_blob = self.model.to_json().encode()
+        compile_kwargs = {
+            "optimizer": keras.optimizers.serialize(self.optimizer),
+            "loss": self.loss,
+            "metrics": self.metrics,
+        }
+        results = spark_run(
+            _train_task,
+            args=(model_blob, compile_kwargs, x, y, self.batch_size,
+                  self.epochs, self.verbose, self.store,
+                  self.checkpoint_path),
+            num_proc=self.num_proc, sc=self.sc)
+        weights = results[0]["weights"]
+        return KerasModel(model_blob=model_blob, weights=weights,
+                          feature_cols=self.feature_cols,
+                          history=results[0]["history"])
+
+
+class KerasModel:
+    """The fitted transformer (reference ``KerasModel``): ``predict`` on
+    arrays, ``transform`` appends predictions to a pandas DataFrame."""
+
+    def __init__(self, model_blob: bytes, weights, feature_cols: List[str],
+                 history=None):
+        self.model_blob = model_blob
+        self.weights = weights
+        self.feature_cols = feature_cols
+        self.history = history
+        self._model = None
+
+    def _keras_model(self):
+        if self._model is None:
+            import keras
+
+            self._model = keras.models.model_from_json(
+                self.model_blob.decode())
+            self._model.set_weights(self.weights)
+        return self._model
+
+    def predict(self, x) -> np.ndarray:
+        # model.predict (not model.__call__) so every Keras 3 backend
+        # returns plain numpy (the torch backend's __call__ yields a
+        # grad-tracking tensor np.asarray refuses).
+        return np.asarray(
+            self._keras_model().predict(np.asarray(x), verbose=0))
+
+    def transform(self, df, output_col: str = "prediction"):
+        if hasattr(df, "loc"):  # pandas
+            out = df.copy()
+            preds = self.predict(df[self.feature_cols].to_numpy())
+            out[output_col] = list(preds)
+            return out
+        x, _ = extract_arrays(df, self.feature_cols, None)
+        return self.predict(x)
+
+    def save(self, store: Store, path: str) -> None:
+        store.save_bytes(path, dumps(
+            {"model": self.model_blob, "weights": self.weights,
+             "feature_cols": self.feature_cols}))
+
+    @classmethod
+    def load(cls, store: Store, path: str) -> "KerasModel":
+        d = loads(store.load_bytes(path))
+        return cls(d["model"], d["weights"], d["feature_cols"])
+
+
+__all__ = ["KerasEstimator", "KerasModel", "LocalStore", "Store"]

@@ -1,0 +1,155 @@
+"""Spark ML-style Torch Estimator.
+
+Role of the reference's ``spark/torch/estimator.py:468`` (``TorchEstimator``
+→ ``TorchModel``): ``fit(df)`` runs distributed PyTorch training as a
+Spark job (WFBP DistributedOptimizer, parameter broadcast, rank-0
+checkpointing) and returns a ``TorchModel`` transformer.  Same slim-downs
+as the Keras flavor (``spark/keras.py``).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..common.pickling import dumps, loads
+from . import run as spark_run
+from .common import LocalStore, Store, extract_arrays, shard
+
+
+def _train_task(model_blob: bytes, opt_factory, loss_fn, x, y,
+                batch_size: int, epochs: int,
+                store: Optional[Store], ckpt_path: str):
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    model = loads(model_blob)
+    optimizer = hvd.DistributedOptimizer(
+        opt_factory(model.parameters()),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
+    if len(sx) == 0:
+        raise ValueError(
+            f"rank {hvd.rank()}'s data shard is empty: the dataset "
+            f"({len(x)} rows) must have at least num_proc={hvd.size()} "
+            "rows")
+    tx = torch.as_tensor(sx, dtype=torch.float32)
+    ty = torch.as_tensor(sy)
+    n = len(tx)
+    losses = []
+    for _ in range(epochs):
+        perm = torch.randperm(n)
+        loss = None
+        for lo in range(0, n, batch_size):
+            idx = perm[lo:lo + batch_size]
+            optimizer.zero_grad()
+            loss = loss_fn(model(tx[idx]), ty[idx])
+            loss.backward()
+            optimizer.step()
+        losses.append(float(loss))
+
+    state = {k: v.cpu() for k, v in model.state_dict().items()} \
+        if hvd.rank() == 0 else None
+    if hvd.rank() == 0 and store is not None:
+        buf = io.BytesIO()
+        torch.save(state, buf)
+        store.save_bytes(ckpt_path, buf.getvalue())
+    return {"state_dict": state, "losses": losses}
+
+
+class TorchEstimator:
+    """``TorchEstimator(model=..., optimizer_factory=..., loss=...).fit(df)``
+    (reference ``spark/torch/estimator.py`` surface; the optimizer is a
+    factory ``params -> torch.optim.Optimizer`` because optimizers bind to
+    a model instance that only exists inside the task)."""
+
+    def __init__(self, model=None, optimizer_factory: Callable = None,
+                 loss=None,
+                 feature_cols: Optional[List[str]] = None,
+                 label_cols: Optional[List[str]] = None,
+                 batch_size: int = 32, epochs: int = 1,
+                 num_proc: Optional[int] = None,
+                 store: Optional[Store] = None,
+                 checkpoint_path: str = "torch_checkpoint.pt", sc=None):
+        self.model = model
+        self.optimizer_factory = optimizer_factory
+        self.loss = loss
+        self.feature_cols = feature_cols or ["features"]
+        self.label_cols = label_cols or ["label"]
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store
+        self.checkpoint_path = checkpoint_path
+        self.sc = sc
+
+    def fit(self, df) -> "TorchModel":
+        x, y = extract_arrays(df, self.feature_cols, self.label_cols)
+        if self.num_proc and len(x) < self.num_proc:
+            raise ValueError(f"dataset has {len(x)} rows < "
+                             f"num_proc={self.num_proc}")
+        model_blob = dumps(self.model)
+        results = spark_run(
+            _train_task,
+            args=(model_blob, self.optimizer_factory, self.loss, x, y,
+                  self.batch_size, self.epochs, self.store,
+                  self.checkpoint_path),
+            num_proc=self.num_proc, sc=self.sc)
+        return TorchModel(model_blob=model_blob,
+                          state_dict=results[0]["state_dict"],
+                          feature_cols=self.feature_cols,
+                          losses=results[0]["losses"])
+
+
+class TorchModel:
+    def __init__(self, model_blob: bytes, state_dict, feature_cols,
+                 losses=None):
+        self.model_blob = model_blob
+        self.state_dict = state_dict
+        self.feature_cols = feature_cols
+        self.losses = losses
+        self._model = None
+
+    def _torch_model(self):
+        if self._model is None:
+            self._model = loads(self.model_blob)
+            self._model.load_state_dict(self.state_dict)
+            self._model.eval()
+        return self._model
+
+    def predict(self, x) -> np.ndarray:
+        import torch
+
+        with torch.no_grad():
+            out = self._torch_model()(
+                torch.as_tensor(np.asarray(x), dtype=torch.float32))
+        return out.numpy()
+
+    def transform(self, df, output_col: str = "prediction"):
+        if hasattr(df, "loc"):  # pandas
+            out = df.copy()
+            preds = self.predict(df[self.feature_cols].to_numpy())
+            out[output_col] = list(preds)
+            return out
+        x, _ = extract_arrays(df, self.feature_cols, None)
+        return self.predict(x)
+
+    def save(self, store: Store, path: str) -> None:
+        store.save_bytes(path, dumps(
+            {"model": self.model_blob, "state": self.state_dict,
+             "feature_cols": self.feature_cols}))
+
+    @classmethod
+    def load(cls, store: Store, path: str) -> "TorchModel":
+        d = loads(store.load_bytes(path))
+        return cls(d["model"], d["state"], d["feature_cols"])
+
+
+__all__ = ["TorchEstimator", "TorchModel", "LocalStore", "Store"]

@@ -50,3 +50,72 @@ def test_spark_task_failure_surfaces():
     with pytest.raises(RuntimeError, match="task exploded"):
         hvd_spark.run(boom, num_proc=1, sc=FakeSparkContext(),
                       start_timeout=30)
+
+
+def test_keras_estimator_fit_transform(tmp_path):
+    keras = pytest.importorskip("keras")
+    import numpy as np
+
+    from horovod_tpu.spark.common import LocalStore
+    from horovod_tpu.spark.keras import KerasEstimator, KerasModel
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("int64")
+
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    store = LocalStore(str(tmp_path))
+    est = KerasEstimator(
+        model=model, optimizer=keras.optimizers.Adam(0.01),
+        loss="sparse_categorical_crossentropy",
+        batch_size=16, epochs=2, num_proc=2, store=store,
+        sc=FakeSparkContext())
+    fitted = est.fit((x, y))
+    preds = fitted.predict(x[:8])
+    assert preds.shape == (8, 2)
+    assert store.exists("keras_checkpoint.npz")
+    # round-trip through the store
+    fitted.save(store, "model.pkl")
+    loaded = KerasModel.load(store, "model.pkl")
+    assert np.allclose(loaded.predict(x[:8]), preds)
+
+
+def test_torch_estimator_fit_transform(tmp_path):
+    torch = pytest.importorskip("torch")
+    import numpy as np
+
+    from horovod_tpu.spark.common import LocalStore
+    from horovod_tpu.spark.torch import TorchEstimator, TorchModel
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(64, 4).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("int64")
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2))
+    store = LocalStore(str(tmp_path))
+    est = TorchEstimator(
+        model=model,
+        optimizer_factory=lambda p: torch.optim.SGD(p, lr=0.05),
+        loss=torch.nn.functional.cross_entropy,
+        batch_size=16, epochs=2, num_proc=2, store=store,
+        sc=FakeSparkContext())
+    fitted = est.fit((x, y))
+    preds = fitted.predict(x[:8])
+    assert preds.shape == (8, 2)
+    assert store.exists("torch_checkpoint.pt")
+    fitted.save(store, "model.pkl")
+    loaded = TorchModel.load(store, "model.pkl")
+    assert np.allclose(loaded.predict(x[:8]), preds)
+
+
+def test_spark_run_rejects_oversubscription():
+    import horovod_tpu.spark as hvd_spark
+
+    with pytest.raises(ValueError, match="exceeds"):
+        hvd_spark.run(lambda: None, num_proc=8,
+                      sc=FakeSparkContext(default_parallelism=2))
