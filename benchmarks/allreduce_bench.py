@@ -79,6 +79,10 @@ def main() -> int:
                 "step_ms": round(step_s * 1e3, 3),
                 "busbw_GBps": round(busbw / 1e9, 3),
                 "scaling_efficiency": round(busbw / base_busbw, 3),
+                # N workers timeshare this host's cores AND its loopback:
+                # when world_size >> host_cpus the efficiency curve
+                # measures the box, not the framework.
+                "host_cpus": os.cpu_count(),
             }
             results.append(rec)
             print(json.dumps(rec), flush=True)

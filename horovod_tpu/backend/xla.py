@@ -282,6 +282,38 @@ class XlaContext:
 
         return self._get(key, build)
 
+    def rows_input(self, local_rows: Any) -> Any:
+        """[R, bucket] local matrix → [P, R, bucket] global array sharded
+        over the process axis (each process contributes its row-block)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shape = (self.topo.size,) + tuple(local_rows.shape)
+        sharding = NamedSharding(self.mesh, P("proc"))
+        local = local_rows[None]
+        if self.topo.size == 1:
+            return jax.device_put(local, sharding)
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, [jax.device_put(local, self.device)])
+
+    def alltoall_fn(self, bucket: int, np_dtype) -> Callable:
+        """[P, P, bucket] sharded (axis 0) → same, with the first two axes
+        swapped: process j ends up holding row-block ``[i][j]`` for every
+        i.  The resharded transpose lowers to one XLA AllToAll over the
+        mesh (MPI_Alltoall role)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("alltoall", bucket, str(np_dtype))
+
+        def build():
+            sh = NamedSharding(self.mesh, P("proc"))
+            return jax.jit(lambda x: jnp.swapaxes(x, 0, 1),
+                           in_shardings=(sh,), out_shardings=sh)
+
+        return self._get(key, build)
+
 
 _context = XlaContext()
 
@@ -419,6 +451,75 @@ class XlaAllgather(XlaOp):
             return jax.jit(f)
 
         entry.output = ctx._get(key, build)(local)
+
+
+class XlaAlltoall(XlaOp):
+    """Uneven-splits alltoall on the device mesh (NCCLAlltoall /
+    MPI_Alltoallv role): each (src → dst) block pads into a fixed bucket
+    row, one XLA AllToAll moves the [P, P, bucket] row-blocks, and the
+    receiver slices its blocks back out by the negotiated split matrix."""
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        return (response.response_type == ResponseType.ALLTOALL
+                and len(entries) == 1
+                and self._common_enabled(response, entries))
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        import jax
+
+        ctx = self.ctx
+        entry = entries[0]
+        size, rank = self.topo.size, self.topo.rank
+        np_dtype = response.tensor_type.to_numpy()
+        # Flattened N×N split matrix (row r = rank r's send splits).
+        matrix = list(response.tensor_sizes)
+        send_splits = matrix[rank * size:(rank + 1) * size]
+        recv_splits = [matrix[r * size + rank] for r in range(size)]
+        entry.received_splits = recv_splits
+        inner = tuple(entry.tensor.shape[1:])
+        inner_n = int(np.prod(inner)) if inner else 1
+        bucket = bucket_elems(max(max(matrix, default=1), 1) * inner_n)
+
+        pack_key = ("a2a.pack", tuple(send_splits), inner,
+                    str(np_dtype), bucket)
+
+        def build_pack():
+            import jax.numpy as jnp
+
+            bounds = np.cumsum([0] + list(send_splits))
+
+            def f(x):
+                rows = []
+                for j in range(size):
+                    blk = x[bounds[j]:bounds[j + 1]].reshape(-1)
+                    rows.append(jnp.pad(blk, (0, bucket - blk.shape[0])))
+                return jnp.stack(rows)
+
+            return jax.jit(f)
+
+        local = jax.device_put(
+            ctx._get(pack_key, build_pack)(entry.tensor), ctx.device)
+        out = ctx.alltoall_fn(bucket, np_dtype)(ctx.rows_input(local))
+        mine = ctx.local_view(out).reshape(size, bucket)
+
+        unpack_key = ("a2a.unpack", tuple(recv_splits), inner,
+                      str(np_dtype), bucket)
+
+        def build_unpack():
+            import jax.numpy as jnp
+
+            def f(x):
+                parts = [x[i, :recv_splits[i] * inner_n].reshape(
+                    (recv_splits[i],) + inner) for i in range(size)]
+                return jnp.concatenate(parts, axis=0)
+
+            return jax.jit(f)
+
+        entry.output = ctx._get(unpack_key, build_unpack)(mine)
+        _count("alltoall")
+        return Status.in_progress()
 
 
 class XlaBroadcast(XlaOp):

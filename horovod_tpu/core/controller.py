@@ -333,6 +333,16 @@ class Controller:
                 error = f"alltoall for {name} cannot complete with joined ranks."
             else:
                 for req in by_rank:
+                    # Trailing dims must agree (like allgather): a
+                    # mismatch would give ranks different row sizes and
+                    # hang the exchange instead of erroring.
+                    if len(req.tensor_shape) != len(first.tensor_shape) \
+                            or req.tensor_shape[1:] != first.tensor_shape[1:]:
+                        error = (f"Mismatched alltoall tensor shapes for "
+                                 f"{name}: all dims but the first must "
+                                 f"match ({first.tensor_shape} vs "
+                                 f"{req.tensor_shape}).")
+                        break
                     if len(req.splits) != self.topo.size:
                         error = (f"alltoall splits for {name} must have one entry "
                                  f"per rank (rank {req.request_rank} sent "

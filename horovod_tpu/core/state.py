@@ -186,6 +186,8 @@ class HorovodGlobalState:
             ResponseType.ALLGATHER, xla_backend.XlaAllgather(topo))
         self.op_manager.register(
             ResponseType.BROADCAST, xla_backend.XlaBroadcast(topo))
+        self.op_manager.register(
+            ResponseType.ALLTOALL, xla_backend.XlaAlltoall(topo))
         # Hierarchical ahead of the flat ring (reference chain order,
         # operations.cc:145-252: NCCL-hierarchical before NCCL); applicable()
         # is pure topology, so every rank registers identically.
@@ -460,7 +462,11 @@ class HorovodGlobalState:
                          splits: Optional[List[int]],
                          callback: Callable[[Status], None]) -> None:
         self._check_initialized()
-        tensor = np.atleast_1d(np.asarray(tensor))
+        tensor, device = self._stage_tensor(tensor)
+        if device == -1:
+            tensor = np.atleast_1d(tensor)
+        elif tensor.ndim == 0:
+            tensor = tensor.reshape(1)
         if splits is None:
             if tensor.shape[0] % self.topo.size != 0:
                 raise ValueError(
@@ -469,11 +475,13 @@ class HorovodGlobalState:
             splits = [tensor.shape[0] // self.topo.size] * self.topo.size
         entry = TensorTableEntry(tensor_name=name, tensor=tensor,
                                  splits=list(splits), callback=callback,
+                                 device=device,
                                  request_type=RequestType.ALLTOALL)
         req = Request(
             request_rank=self.topo.rank, request_type=RequestType.ALLTOALL,
             tensor_name=name, tensor_type=DataType.from_numpy(tensor.dtype),
-            tensor_shape=list(tensor.shape), splits=list(splits))
+            tensor_shape=list(tensor.shape), splits=list(splits),
+            device=device)
         self.tensor_queue.add(entry, req)
 
     def enqueue_join(self) -> threading.Event:

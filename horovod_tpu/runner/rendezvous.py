@@ -95,6 +95,10 @@ class _Handler(BaseHTTPRequestHandler):
 class _KVServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # ThreadingHTTPServer's default listen backlog is 5: a whole job's
+    # workers rendezvous simultaneously, and anything past the backlog
+    # gets RST at 16+ ranks (found by benchmarks/controller_bench.py).
+    request_queue_size = 128
 
     def __init__(self, addr, delete_hook=None, job_secret=None):
         super().__init__(addr, _Handler)

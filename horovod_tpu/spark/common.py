@@ -77,7 +77,12 @@ def extract_arrays(df, feature_cols: List[str],
         cols = feature_cols + (label_cols or [])
         rows = df.select(*cols).collect()
         nf = len(feature_cols)
-        x = np.asarray([[row[i] for i in range(nf)] for row in rows])
+        # A feature column may itself be a Spark ML vector (the standard
+        # VectorAssembler 'features' convention): flatten each row's
+        # columns into one feature vector regardless.
+        x = np.asarray([np.concatenate(
+            [np.atleast_1d(np.asarray(row[i])) for i in range(nf)])
+            for row in rows])
         if not label_cols:
             return x, None
         y = np.asarray([[row[nf + i] for i in range(len(label_cols))]
@@ -96,5 +101,16 @@ def extract_arrays(df, feature_cols: List[str],
 def shard(x: np.ndarray, y: np.ndarray, rank: int,
           size: int) -> Tuple[np.ndarray, np.ndarray]:
     """Rank's slice of the dataset (the reference shards via Petastorm row
-    groups; modulo striping keeps label distribution even)."""
-    return x[rank::size], y[rank::size]
+    groups; modulo striping keeps label distribution even).
+
+    Shards are padded by wrap-around to EQUAL length: per-step gradient
+    allreduces are collective, so every rank must run the identical number
+    of optimizer steps per epoch — a one-row difference would pair rank
+    A's step k with rank B's step k+1 and finally deadlock."""
+    sx, sy = x[rank::size], y[rank::size]
+    target = -(-len(x) // size)  # ceil
+    if 0 < len(sx) < target:
+        pad = target - len(sx)
+        sx = np.concatenate([sx, sx[:pad]])
+        sy = np.concatenate([sy, sy[:pad]])
+    return sx, sy

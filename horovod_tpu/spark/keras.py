@@ -43,6 +43,11 @@ def _train_task(model_blob: bytes, compile_kwargs: dict, x, y,
                   loss=loss, metrics=metrics)
 
     sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
+    if len(sx) == 0:
+        raise ValueError(
+            f"rank {hvd.rank()}'s data shard is empty: the dataset "
+            f"({len(x)} rows) must have at least num_proc={hvd.size()} "
+            "rows")
     callbacks = [hvd.BroadcastGlobalVariablesCallback(0)]
     history = model.fit(sx, sy, batch_size=batch_size, epochs=epochs,
                         verbose=verbose, callbacks=callbacks)
@@ -52,6 +57,10 @@ def _train_task(model_blob: bytes, compile_kwargs: dict, x, y,
         buf = io.BytesIO()
         np.savez(buf, *weights)
         store.save_bytes(ckpt_path, buf.getvalue())
+    # Explicit teardown: real Spark reuses python workers across jobs,
+    # and a second fit() must re-init against ITS rendezvous, not no-op
+    # into this one's dead mesh.
+    hvd.shutdown()
     return {"weights": weights, "history": history.history}
 
 
@@ -85,9 +94,11 @@ class KerasEstimator:
         import keras
 
         x, y = extract_arrays(df, self.feature_cols, self.label_cols)
-        if self.num_proc and len(x) < self.num_proc:
+        n_proc = self.num_proc or int(
+            getattr(self.sc, "defaultParallelism", 0) or 0)
+        if n_proc and len(x) < n_proc:
             raise ValueError(f"dataset has {len(x)} rows < "
-                             f"num_proc={self.num_proc}")
+                             f"num_proc={n_proc}")
         model_blob = self.model.to_json().encode()
         compile_kwargs = {
             "optimizer": keras.optimizers.serialize(self.optimizer),

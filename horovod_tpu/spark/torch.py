@@ -61,6 +61,7 @@ def _train_task(model_blob: bytes, opt_factory, loss_fn, x, y,
         buf = io.BytesIO()
         torch.save(state, buf)
         store.save_bytes(ckpt_path, buf.getvalue())
+    hvd.shutdown()  # see keras.py: Spark reuses python workers
     return {"state_dict": state, "losses": losses}
 
 
@@ -92,9 +93,11 @@ class TorchEstimator:
 
     def fit(self, df) -> "TorchModel":
         x, y = extract_arrays(df, self.feature_cols, self.label_cols)
-        if self.num_proc and len(x) < self.num_proc:
+        n_proc = self.num_proc or int(
+            getattr(self.sc, "defaultParallelism", 0) or 0)
+        if n_proc and len(x) < n_proc:
             raise ValueError(f"dataset has {len(x)} rows < "
-                             f"num_proc={self.num_proc}")
+                             f"num_proc={n_proc}")
         model_blob = dumps(self.model)
         results = spark_run(
             _train_task,

@@ -116,15 +116,29 @@ class HTTPStoreClient(Store):
                                            data or b""))
         return req
 
+    def _open_with_retry(self, req: urllib.request.Request):
+        """Transient-failure retry: a whole job's workers hit the server
+        at once and connections can be reset under burst load; signed
+        requests are idempotent KV ops, safe to replay."""
+        last: Optional[Exception] = None
+        for attempt in range(4):
+            try:
+                return urllib.request.urlopen(req, timeout=self._timeout)
+            except urllib.error.HTTPError:
+                raise  # protocol-level answer (404/403): not transient
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last = e
+                time.sleep(0.05 * (attempt + 1))
+        raise last
+
     def set(self, scope: str, key: str, value: bytes) -> None:
-        req = self._request(scope, key, "PUT", value)
-        with urllib.request.urlopen(req, timeout=self._timeout):
+        with self._open_with_retry(self._request(scope, key, "PUT", value)):
             pass
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        req = self._request(scope, key, "GET")
         try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            with self._open_with_retry(
+                    self._request(scope, key, "GET")) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:

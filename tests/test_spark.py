@@ -119,3 +119,38 @@ def test_spark_run_rejects_oversubscription():
     with pytest.raises(ValueError, match="exceeds"):
         hvd_spark.run(lambda: None, num_proc=8,
                       sc=FakeSparkContext(default_parallelism=2))
+
+
+def test_spark_estimator_uneven_dataset():
+    """65 rows over 2 ranks: shards pad to equal step counts so the
+    per-step allreduces stay paired (would deadlock otherwise)."""
+    torch = pytest.importorskip("torch")
+    import numpy as np
+
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(65, 4).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("int64")
+    model = torch.nn.Linear(4, 2)
+    est = TorchEstimator(
+        model=model,
+        optimizer_factory=lambda p: torch.optim.SGD(p, lr=0.05),
+        loss=torch.nn.functional.cross_entropy,
+        batch_size=16, epochs=1, num_proc=2, sc=FakeSparkContext())
+    fitted = est.fit((x, y))
+    assert fitted.predict(x[:4]).shape == (4, 2)
+
+
+def test_shard_equalizes_lengths():
+    import numpy as np
+
+    from horovod_tpu.spark.common import shard
+
+    x = np.arange(65)
+    y = np.arange(65) * 2
+    s0x, s0y = shard(x, y, 0, 2)
+    s1x, s1y = shard(x, y, 1, 2)
+    assert len(s0x) == len(s1x) == 33
+    assert np.array_equal(s1x[-1:], s1x[:1])  # wrap-around pad
+    assert np.array_equal(s0y, s0x * 2) and np.array_equal(s1y, s1x * 2)

@@ -275,3 +275,40 @@ print("TUNUSED_OK", rank, flush=True)
 """, timeout=240)
     for r, o in enumerate(out):
         assert f"TUNUSED_OK {r}" in o
+
+
+def test_keras_load_model_preserves_optimizer_state(tmp_path):
+    """hvd keras load_model must keep the checkpoint's optimizer slot
+    variables and iteration count (in-place class swap, not from_config
+    reconstruction)."""
+    out = run_distributed(1, f"""
+import os
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = "2"
+import keras
+import horovod_tpu.keras as hk
+
+model = keras.Sequential([keras.layers.Input((4,)), keras.layers.Dense(2)])
+model.compile(optimizer=keras.optimizers.Adam(0.01), loss="mse")
+x = np.random.RandomState(0).randn(16, 4).astype("float32")
+y = np.random.RandomState(1).randn(16, 2).astype("float32")
+model.fit(x, y, epochs=2, batch_size=8, verbose=0)
+iters_before = int(model.optimizer.iterations.numpy())
+assert iters_before > 0
+path = {str(tmp_path)!r} + "/m.keras"
+model.save(path)
+
+loaded = hk.load_model(path)
+assert type(loaded.optimizer).__name__.startswith("Distributed"), \\
+    type(loaded.optimizer)
+assert int(loaded.optimizer.iterations.numpy()) == iters_before, \\
+    (int(loaded.optimizer.iterations.numpy()), iters_before)
+# moments restored: at least one nonzero slot variable
+slots = [v for v in loaded.optimizer.variables
+         if "momentum" in v.path or "velocity" in v.path or "m" in v.name]
+assert any(float(abs(np.asarray(v)).sum()) > 0 for v in slots), \\
+    [v.path for v in loaded.optimizer.variables]
+# and it still trains distributed
+loaded.fit(x, y, epochs=1, batch_size=8, verbose=0)
+print("KLOAD_OK", rank, flush=True)
+""", timeout=240)
+    assert "KLOAD_OK 0" in out[0]

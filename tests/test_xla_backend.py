@@ -163,3 +163,30 @@ print("XLA_BUCKET_OK", rank, flush=True)
 """, extra_env=_xla_env())
     for r, o in enumerate(out):
         assert f"XLA_BUCKET_OK {r}" in o
+
+
+def test_xla_multiprocess_alltoall_uneven_splits():
+    """Device alltoall: uneven (src → dst) blocks ride one XLA AllToAll
+    (NCCLAlltoall role); received_splits surface like the TCP path."""
+    out = run_distributed(2, _ASSERT_XLA + """
+import jax.numpy as jnp
+import horovod_tpu.frameworks.jax.ops as ops
+
+# rank 0 sends 1 row to rank 0 and 2 rows to rank 1; rank 1 sends 2/1.
+splits = [1, 2] if rank == 0 else [2, 1]
+x = jnp.arange(3 * 2, dtype=jnp.float32).reshape(3, 2) + 100 * rank
+o, rsplits = ops.alltoall(x, splits=splits, name="da2a",
+                          return_received_splits=True)
+# recv from r = r's send split toward me: rank0 gets [1, 2], rank1 [2, 1]
+exp_rsplits = [1, 2] if rank == 0 else [2, 1]
+assert list(rsplits) == exp_rsplits, rsplits
+x0 = np.arange(6, dtype=np.float32).reshape(3, 2)
+x1 = x0 + 100
+exp = np.concatenate([x0[0:1], x1[0:2]]) if rank == 0 \
+    else np.concatenate([x0[1:3], x1[2:3]])
+assert np.allclose(np.asarray(o), exp), (np.asarray(o), exp)
+assert stats.get("alltoall", 0) >= 1, stats
+print("XLA_A2A_OK", rank, flush=True)
+""", extra_env=_xla_env())
+    for r, o in enumerate(out):
+        assert f"XLA_A2A_OK {r}" in o
