@@ -1,0 +1,108 @@
+"""BERT-large pretraining-step benchmark: tokens/sec + MFU on one chip.
+
+BASELINE.md's scaling target names BERT-large alongside ResNet-50; this
+is the transformer-side companion of ``bench.py`` (same MFU methodology:
+XLA cost-model FLOPs over the chip's bf16 peak).  Transformers are
+matmul-dominated, so this is the number that shows how close the model
+stack gets to the MXU's ceiling — convnets (ResNet) are capped far lower
+by small-channel convs and batch-norm memory traffic.
+
+Run: ``python benchmarks/bert_bench.py [--batch-size 8 --seq-len 512]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _PEAK_FLOPS, _peak_for  # noqa: E402  (shared tables)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models.transformer import (
+        Transformer,
+        bert_large_config,
+        tiny_config,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    bs = args.batch_size or (8 if on_tpu else 2)
+    seq = args.seq_len or (512 if on_tpu else 32)
+    cfg = bert_large_config(max_len=seq, causal=False) if on_tpu \
+        else tiny_config(max_len=seq, causal=False)
+    model = Transformer(cfg)
+    tx = optax.adamw(1e-4)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)),
+                         jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt_state = tx.init(params)
+
+    def loss_fn(params, toks):
+        logits = model.apply({"params": params}, toks)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, toks).mean()
+
+    def step(params, opt_state, toks):
+        loss, grads = jax.value_and_grad(loss_fn)(params, toks)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    compiled = jax.jit(step, donate_argnums=(0, 1)).lower(
+        params, opt_state, tokens).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        flops_per_step = float(ca["flops"])
+        src = "xla_cost_analysis"
+    except Exception:  # noqa: BLE001
+        # 6 * params * tokens approximation (fwd+bwd), params ~334M
+        flops_per_step = 6 * 334e6 * bs * seq
+        src = "analytic"
+
+    for _ in range(3):
+        params, opt_state, loss = compiled(params, opt_state, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, opt_state, loss = compiled(params, opt_state, tokens)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+
+    flops_per_sec = flops_per_step * args.iters / dt
+    peak = _peak_for(jax.devices()[0]) if on_tpu else None
+    print(json.dumps({
+        "metric": "bert_large_tokens_per_sec_per_chip" if on_tpu
+        else "tiny_transformer_tokens_per_sec",
+        "value": round(bs * seq * args.iters / dt, 1),
+        "unit": "tokens/sec/chip",
+        "mfu": round(flops_per_sec / peak, 4) if peak else 0.0,
+        "tflops_per_sec_per_chip": round(flops_per_sec / 1e12, 2),
+        "flops_source": src,
+        "batch_size": bs,
+        "seq_len": seq,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

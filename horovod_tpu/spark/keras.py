@@ -42,26 +42,28 @@ def _train_task(model_blob: bytes, compile_kwargs: dict, x, y,
     model.compile(optimizer=hvd.DistributedOptimizer(optimizer),
                   loss=loss, metrics=metrics)
 
-    sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
-    if len(sx) == 0:
-        raise ValueError(
-            f"rank {hvd.rank()}'s data shard is empty: the dataset "
-            f"({len(x)} rows) must have at least num_proc={hvd.size()} "
-            "rows")
-    callbacks = [hvd.BroadcastGlobalVariablesCallback(0)]
-    history = model.fit(sx, sy, batch_size=batch_size, epochs=epochs,
-                        verbose=verbose, callbacks=callbacks)
+    # try/finally teardown: real Spark reuses python workers across jobs,
+    # and a later fit() must re-init against ITS rendezvous, not no-op
+    # into this one's dead mesh — including when training raises.
+    try:
+        sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
+        if len(sx) == 0:
+            raise ValueError(
+                f"rank {hvd.rank()}'s data shard is empty: the dataset "
+                f"({len(x)} rows) must have at least num_proc={hvd.size()} "
+                "rows")
+        callbacks = [hvd.BroadcastGlobalVariablesCallback(0)]
+        history = model.fit(sx, sy, batch_size=batch_size, epochs=epochs,
+                            verbose=verbose, callbacks=callbacks)
 
-    weights = model.get_weights() if hvd.rank() == 0 else None
-    if hvd.rank() == 0 and store is not None:
-        buf = io.BytesIO()
-        np.savez(buf, *weights)
-        store.save_bytes(ckpt_path, buf.getvalue())
-    # Explicit teardown: real Spark reuses python workers across jobs,
-    # and a second fit() must re-init against ITS rendezvous, not no-op
-    # into this one's dead mesh.
-    hvd.shutdown()
-    return {"weights": weights, "history": history.history}
+        weights = model.get_weights() if hvd.rank() == 0 else None
+        if hvd.rank() == 0 and store is not None:
+            buf = io.BytesIO()
+            np.savez(buf, *weights)
+            store.save_bytes(ckpt_path, buf.getvalue())
+        return {"weights": weights, "history": history.history}
+    finally:
+        hvd.shutdown()
 
 
 class KerasEstimator:
@@ -93,9 +95,12 @@ class KerasEstimator:
     def fit(self, df) -> "KerasModel":
         import keras
 
+        from . import _default_spark_context
+
+        sc = self.sc or _default_spark_context()
         x, y = extract_arrays(df, self.feature_cols, self.label_cols)
         n_proc = self.num_proc or int(
-            getattr(self.sc, "defaultParallelism", 0) or 0)
+            getattr(sc, "defaultParallelism", 0) or 0)
         if n_proc and len(x) < n_proc:
             raise ValueError(f"dataset has {len(x)} rows < "
                              f"num_proc={n_proc}")
@@ -110,7 +115,7 @@ class KerasEstimator:
             args=(model_blob, compile_kwargs, x, y, self.batch_size,
                   self.epochs, self.verbose, self.store,
                   self.checkpoint_path),
-            num_proc=self.num_proc, sc=self.sc)
+            num_proc=self.num_proc, sc=sc)
         weights = results[0]["weights"]
         return KerasModel(model_blob=model_blob, weights=weights,
                           feature_cols=self.feature_cols,

@@ -27,42 +27,46 @@ def _train_task(model_blob: bytes, opt_factory, loss_fn, x, y,
     import horovod_tpu.torch as hvd
 
     hvd.init()
-    model = loads(model_blob)
-    optimizer = hvd.DistributedOptimizer(
-        opt_factory(model.parameters()),
-        named_parameters=model.named_parameters())
-    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
-    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    # try/finally teardown: see keras.py — reused Spark python workers
+    # must re-init cleanly even when training raises.
+    try:
+        model = loads(model_blob)
+        optimizer = hvd.DistributedOptimizer(
+            opt_factory(model.parameters()),
+            named_parameters=model.named_parameters())
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
-    sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
-    if len(sx) == 0:
-        raise ValueError(
-            f"rank {hvd.rank()}'s data shard is empty: the dataset "
-            f"({len(x)} rows) must have at least num_proc={hvd.size()} "
-            "rows")
-    tx = torch.as_tensor(sx, dtype=torch.float32)
-    ty = torch.as_tensor(sy)
-    n = len(tx)
-    losses = []
-    for _ in range(epochs):
-        perm = torch.randperm(n)
-        loss = None
-        for lo in range(0, n, batch_size):
-            idx = perm[lo:lo + batch_size]
-            optimizer.zero_grad()
-            loss = loss_fn(model(tx[idx]), ty[idx])
-            loss.backward()
-            optimizer.step()
-        losses.append(float(loss))
+        sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
+        if len(sx) == 0:
+            raise ValueError(
+                f"rank {hvd.rank()}'s data shard is empty: the dataset "
+                f"({len(x)} rows) must have at least num_proc={hvd.size()} "
+                "rows")
+        tx = torch.as_tensor(sx, dtype=torch.float32)
+        ty = torch.as_tensor(sy)
+        n = len(tx)
+        losses = []
+        for _ in range(epochs):
+            perm = torch.randperm(n)
+            loss = None
+            for lo in range(0, n, batch_size):
+                idx = perm[lo:lo + batch_size]
+                optimizer.zero_grad()
+                loss = loss_fn(model(tx[idx]), ty[idx])
+                loss.backward()
+                optimizer.step()
+            losses.append(float(loss))
 
-    state = {k: v.cpu() for k, v in model.state_dict().items()} \
-        if hvd.rank() == 0 else None
-    if hvd.rank() == 0 and store is not None:
-        buf = io.BytesIO()
-        torch.save(state, buf)
-        store.save_bytes(ckpt_path, buf.getvalue())
-    hvd.shutdown()  # see keras.py: Spark reuses python workers
-    return {"state_dict": state, "losses": losses}
+        state = {k: v.cpu() for k, v in model.state_dict().items()} \
+            if hvd.rank() == 0 else None
+        if hvd.rank() == 0 and store is not None:
+            buf = io.BytesIO()
+            torch.save(state, buf)
+            store.save_bytes(ckpt_path, buf.getvalue())
+        return {"state_dict": state, "losses": losses}
+    finally:
+        hvd.shutdown()
 
 
 class TorchEstimator:
@@ -92,9 +96,12 @@ class TorchEstimator:
         self.sc = sc
 
     def fit(self, df) -> "TorchModel":
+        from . import _default_spark_context
+
+        sc = self.sc or _default_spark_context()
         x, y = extract_arrays(df, self.feature_cols, self.label_cols)
         n_proc = self.num_proc or int(
-            getattr(self.sc, "defaultParallelism", 0) or 0)
+            getattr(sc, "defaultParallelism", 0) or 0)
         if n_proc and len(x) < n_proc:
             raise ValueError(f"dataset has {len(x)} rows < "
                              f"num_proc={n_proc}")
@@ -104,7 +111,7 @@ class TorchEstimator:
             args=(model_blob, self.optimizer_factory, self.loss, x, y,
                   self.batch_size, self.epochs, self.store,
                   self.checkpoint_path),
-            num_proc=self.num_proc, sc=self.sc)
+            num_proc=self.num_proc, sc=sc)
         return TorchModel(model_blob=model_blob,
                           state_dict=results[0]["state_dict"],
                           feature_cols=self.feature_cols,
