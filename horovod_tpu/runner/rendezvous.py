@@ -168,6 +168,10 @@ class RendezvousServer:
         assert self._server is not None
         return self._server.store_get(scope, key)
 
+    def keys(self, scope: str) -> List[str]:
+        assert self._server is not None
+        return self._server._store.keys(scope)
+
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()

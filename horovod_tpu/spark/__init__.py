@@ -194,3 +194,172 @@ def _make_mapper(fn, args, kwargs, rdv_addr, port, key, start_timeout,
                        start_timeout, extra_env)
 
     return _mapper
+
+
+# ---------------------------------------------------------------------------
+# elastic (reference ``horovod.spark.run_elastic``, spark/runner.py:303)
+# ---------------------------------------------------------------------------
+
+_ECMD_SCOPE = "spark.cmd"
+_EEXIT_SCOPE = "spark.exit"
+
+
+def _elastic_task_fn(index: int, fn: Callable, args: tuple, kwargs: dict,
+                     rdv_addr: str, rdv_port: int, key: str,
+                     start_timeout: float, extra_env: Dict[str, str]):
+    """Elastic Spark task: register as a single-slot host, wait for the
+    driver's slot assignment, run ``fn`` under the in-process elastic
+    machinery.  Each task ATTEMPT is an individual host, like the
+    reference salting its host hash per attempt (``spark/runner.py:52-55``):
+    the attempt-unique identity means a Spark retry registers as a fresh
+    host with fresh cmd/exit keys and rejoins the job, while the dead
+    attempt's exit marker keeps it out of discovery."""
+    import secrets as _secrets
+
+    os.environ[env_mod.HOROVOD_SECRET_KEY] = key
+    from ..transport.store import HTTPStoreClient
+
+    store = HTTPStoreClient(rdv_addr, rdv_port)
+    identity = f"task-{index}-{_secrets.token_hex(4)}"
+    store.set(_REG_SCOPE, identity, b"1")
+    got = store.wait(_ECMD_SCOPE, [identity], timeout=start_timeout)
+    env = json.loads(got[identity].decode())
+    os.environ.update({k: str(v) for k, v in env.items()})
+    os.environ.update({k: str(v) for k, v in extra_env.items()})
+    code = 0
+    try:
+        result = fn(*args, **kwargs)
+        store.set(_RESULT_SCOPE, identity, _dumps(result))
+    except SystemExit as e:
+        # Preserve elastic exit semantics: the in-process machinery uses
+        # a distinct TRANSIENT exit code for "my peer died, recycle me" —
+        # flattening it to 1 would count the healthy survivor against the
+        # much stricter crash blacklist threshold.
+        code = int(e.code or 0)
+        raise
+    except BaseException:
+        code = 1
+        raise
+    finally:
+        store.set(_EEXIT_SCOPE, identity, str(code).encode())
+    return index
+
+
+def run_elastic(fn: Callable, args: tuple = (),
+                kwargs: Optional[dict] = None,
+                num_proc: Optional[int] = None, min_np: int = 1,
+                max_np: Optional[int] = None, sc=None,
+                extra_env: Optional[Dict[str, str]] = None,
+                start_timeout: float = 120.0) -> List[Any]:
+    """Elastic job over Spark tasks (reference ``horovod.spark.run_elastic``,
+    ``spark/runner.py:303``): Spark provides up to ``num_proc`` task
+    slots, the shared ElasticDriver assigns ranks and survives task loss
+    down to ``min_np`` (Spark's own task retry provides replacement
+    hosts); returns the successful ranks' results."""
+    from ..elastic.discovery import HostDiscovery, HostManager
+    from ..elastic.driver import ElasticDriver
+    from ..elastic.registration import FAILURE, SUCCESS
+    from ..runner.hosts import SlotInfo
+    from ..transport.tcp import _default_advertise_addr
+
+    sc = sc or _default_spark_context()
+    if num_proc is None:
+        num_proc = int(sc.defaultParallelism)
+    kwargs = kwargs or {}
+
+    key = secret_mod.ensure_job_secret()
+    server = RendezvousServer(bind_addr="0.0.0.0", job_secret=key.encode())
+    port = server.start()
+    rdv_addr = _default_advertise_addr()
+
+    class _SparkTaskDiscovery(HostDiscovery):
+        """Registered, not-yet-exited Spark task ATTEMPTS are the host
+        set (attempt-unique identities; see _elastic_task_fn)."""
+
+        def find_available_hosts_and_slots(self) -> Dict[str, int]:
+            return {identity: 1
+                    for identity in server.keys(_REG_SCOPE)
+                    if server.get(_EEXIT_SCOPE, identity) is None}
+
+    driver = ElasticDriver(server, HostManager(_SparkTaskDiscovery()),
+                           min_np=min_np, max_np=max_np or num_proc,
+                           timeout=start_timeout)
+
+    def create_worker(slot: SlotInfo, epoch: int) -> None:
+        env = dict(slot.to_env())
+        env.update({
+            env_mod.HOROVOD_RENDEZVOUS_ADDR: rdv_addr,
+            env_mod.HOROVOD_RENDEZVOUS_PORT: str(port),
+            env_mod.HOROVOD_CONTROLLER: "tcp",
+            env_mod.HOROVOD_ELASTIC: "1",
+            "HOROVOD_EPOCH": str(epoch),
+        })
+        server.set(_ECMD_SCOPE, slot.hostname, json.dumps(env).encode())
+
+    monitor_stop = threading.Event()
+
+    def monitor():
+        seen: set = set()
+        while not monitor_stop.is_set():
+            for slot in driver.current_slots:
+                identity = f"{slot.hostname}:{slot.local_rank}"
+                raw = server.get(_EEXIT_SCOPE, slot.hostname)
+                if raw is not None and identity not in seen:
+                    seen.add(identity)
+                    driver.record_worker_exit(slot, int(raw.decode()))
+            time.sleep(0.2)
+
+    mapper = _make_elastic_mapper(fn, args, kwargs, rdv_addr, port, key,
+                                  start_timeout, dict(extra_env or {}))
+    spark_err: List[BaseException] = []
+
+    def spark_job():
+        try:
+            # Per-task results flow through the KV store (keyed by the
+            # winning attempt identities); collect() only drives execution.
+            sc.parallelize(range(num_proc), num_proc) \
+                .mapPartitionsWithIndex(mapper).collect()
+        except BaseException as e:  # noqa: BLE001 — surfaced by the loop
+            spark_err.append(e)
+
+    job_thread = threading.Thread(target=spark_job, daemon=True,
+                                  name="hvd-spark-elastic-job")
+    job_thread.start()
+    try:
+        driver.start(create_worker)
+        threading.Thread(target=monitor, daemon=True,
+                         name="hvd-spark-elastic-mon").start()
+        while True:
+            time.sleep(0.3)
+            successes = driver._registry.count(SUCCESS)
+            failures = driver._registry.count(FAILURE)
+            all_exited = not driver.hosts.total_slots()
+            if successes and all_exited:
+                break  # every attempt done, at least one rank succeeded
+            if all_exited and failures and not successes:
+                raise RuntimeError(
+                    f"elastic spark job lost all capacity "
+                    f"({failures} failures)")
+            if spark_err and not successes:
+                raise spark_err[0]
+            if driver.stopped_error:
+                raise RuntimeError(driver.stopped_error)
+        out: Dict[int, Any] = {}
+        for slot in driver.current_slots:
+            blob = server.get(_RESULT_SCOPE, slot.hostname)
+            if blob is not None:
+                out[slot.rank] = _loads(blob)
+        return [out[r] for r in sorted(out)]
+    finally:
+        monitor_stop.set()
+        driver.stop()
+        server.stop()
+
+
+def _make_elastic_mapper(fn, args, kwargs, rdv_addr, port, key,
+                         start_timeout, extra_env):
+    def _mapper(index, _iterator):
+        yield _elastic_task_fn(index, fn, args, kwargs, rdv_addr, port,
+                               key, start_timeout, extra_env)
+
+    return _mapper

@@ -32,20 +32,21 @@ def _train_task(model_blob: bytes, compile_kwargs: dict, x, y,
     import horovod_tpu.keras as hvd
 
     hvd.init()
-    import keras
-
-    model = keras.models.model_from_json(model_blob.decode())
-    opt_cfg, loss, metrics = (compile_kwargs["optimizer"],
-                              compile_kwargs["loss"],
-                              compile_kwargs.get("metrics"))
-    optimizer = keras.optimizers.deserialize(opt_cfg)
-    model.compile(optimizer=hvd.DistributedOptimizer(optimizer),
-                  loss=loss, metrics=metrics)
-
-    # try/finally teardown: real Spark reuses python workers across jobs,
-    # and a later fit() must re-init against ITS rendezvous, not no-op
-    # into this one's dead mesh — including when training raises.
+    # try/finally teardown from the moment the runtime is up: real Spark
+    # reuses python workers across jobs, and a later fit() must re-init
+    # against ITS rendezvous, not no-op into this one's dead mesh — even
+    # when deserialization/compile/training raises.
     try:
+        import keras
+
+        model = keras.models.model_from_json(model_blob.decode())
+        opt_cfg, loss, metrics = (compile_kwargs["optimizer"],
+                                  compile_kwargs["loss"],
+                                  compile_kwargs.get("metrics"))
+        optimizer = keras.optimizers.deserialize(opt_cfg)
+        model.compile(optimizer=hvd.DistributedOptimizer(optimizer),
+                      loss=loss, metrics=metrics)
+
         sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
         if len(sx) == 0:
             raise ValueError(

@@ -83,6 +83,14 @@ class MemoryStore(Store):
         with self._cv:
             return self._data.pop(f"{scope}/{key}", None)
 
+    def keys(self, scope: str) -> List[str]:
+        """All keys currently present in a scope (driver-side enumeration
+        of dynamically-registered workers)."""
+        prefix = f"{scope}/"
+        with self._cv:
+            return [k[len(prefix):] for k in self._data
+                    if k.startswith(prefix)]
+
 
 class HTTPStoreClient(Store):
     """Client for the launcher's rendezvous HTTP KV server.

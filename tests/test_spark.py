@@ -154,3 +154,27 @@ def test_shard_equalizes_lengths():
     assert len(s0x) == len(s1x) == 33
     assert np.array_equal(s1x[-1:], s1x[:1])  # wrap-around pad
     assert np.array_equal(s0y, s0x * 2) and np.array_equal(s1y, s1x * 2)
+
+
+def _elastic_fn():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = float(np.asarray(hvd.allreduce(np.ones(2), op=hvd.Sum,
+                                         name="se"))[0])
+    hvd.shutdown()
+    return out
+
+
+def test_spark_run_elastic_end_to_end():
+    import horovod_tpu.spark as hvd_spark
+
+    results = hvd_spark.run_elastic(
+        _elastic_fn, num_proc=2, min_np=1, sc=FakeSparkContext(),
+        extra_env={"JAX_PLATFORMS": "cpu"}, start_timeout=60)
+    assert results == [2.0, 2.0], results
