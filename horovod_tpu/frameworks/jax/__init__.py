@@ -7,18 +7,30 @@ the reference's ``horovod.torch``/``horovod.tensorflow`` surfaces
 """
 
 from .basics import (  # noqa: F401
+    ccl_built,
     cross_rank,
     cross_size,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
     init,
     is_homogeneous,
     is_initialized,
     local_rank,
     local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
     rank,
+    rocm_built,
     shutdown,
     size,
     start_timeline,
     stop_timeline,
+    xla_built,
+    xla_enabled,
 )
 from .ops import (  # noqa: F401
     Adasum,

@@ -148,6 +148,67 @@ def stop_timeline() -> None:
         state.controller.timeline = None
 
 
+# ---------------------------------------------------------------------------
+# capability predicates (reference basics.py:160-260) — ported scripts use
+# these as guards (`if hvd.nccl_built(): ...`).  Truthful answers for a
+# TPU-native build: the GPU/MPI-era backends don't exist here, the XLA
+# device plane and the self-contained TCP fabric do.
+# ---------------------------------------------------------------------------
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    # The TCP mesh plays the Gloo role and is always compiled in.
+    return True
+
+
+def gloo_built() -> bool:
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """TPU-native addition: the XLA device data plane is available."""
+    return True
+
+
+def xla_enabled() -> bool:
+    """True when the eager device plane is active in this process."""
+    from ...backend import xla as xla_backend
+
+    return xla_backend.context().ready
+
+
 def _internal_reset() -> None:
     """Full teardown + fresh state (elastic re-init path and tests)."""
     reset_global_state()

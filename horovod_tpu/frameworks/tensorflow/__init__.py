@@ -25,18 +25,30 @@ import numpy as np
 
 from ...common.exceptions import HorovodInternalError
 from ..jax.basics import (
+    ccl_built,
     cross_rank,
     cross_size,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
     init,
     is_homogeneous,
     is_initialized,
     local_rank,
     local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
     rank,
+    rocm_built,
     shutdown,
     size,
     start_timeline,
     stop_timeline,
+    xla_built,
+    xla_enabled,
 )
 from ..jax.ops import (
     Adasum,
@@ -381,6 +393,9 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "is_homogeneous",
     "start_timeline", "stop_timeline",
+    "mpi_threads_supported", "mpi_enabled", "mpi_built", "gloo_enabled",
+    "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+    "rocm_built", "xla_built", "xla_enabled",
     "allreduce", "allgather", "broadcast", "alltoall", "join", "barrier",
     "poll", "synchronize",
     "broadcast_variables", "broadcast_object", "allgather_object",

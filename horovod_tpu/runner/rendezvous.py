@@ -121,6 +121,9 @@ class _KVServer(ThreadingHTTPServer):
             self._delete_hook(scope, key)
         return existed
 
+    def store_keys(self, scope: str) -> List[str]:
+        return self._store.keys(scope)
+
 
 class RendezvousServer:
     """Launcher-side KV server; start() returns the bound port."""
@@ -170,7 +173,7 @@ class RendezvousServer:
 
     def keys(self, scope: str) -> List[str]:
         assert self._server is not None
-        return self._server._store.keys(scope)
+        return self._server.store_keys(scope)
 
     def stop(self) -> None:
         if self._server is not None:

@@ -202,6 +202,13 @@ def _make_mapper(fn, args, kwargs, rdv_addr, port, key, start_timeout,
 
 _ECMD_SCOPE = "spark.cmd"
 _EEXIT_SCOPE = "spark.exit"
+_EBEAT_SCOPE = "spark.beat"
+
+# A task whose heartbeat counter hasn't advanced for this long is treated
+# as dead even without an exit marker (SIGKILLed executors never write
+# one); compared against a driver-local monotonic clock, so client clock
+# skew is irrelevant.
+_BEAT_STALE_SECS = 10.0
 
 
 def _elastic_task_fn(index: int, fn: Callable, args: tuple, kwargs: dict,
@@ -222,25 +229,45 @@ def _elastic_task_fn(index: int, fn: Callable, args: tuple, kwargs: dict,
     store = HTTPStoreClient(rdv_addr, rdv_port)
     identity = f"task-{index}-{_secrets.token_hex(4)}"
     store.set(_REG_SCOPE, identity, b"1")
+
+    # Heartbeat: a counter the driver watches with ITS clock — a
+    # SIGKILLed executor writes no exit marker, and only a stalled beat
+    # reveals it (the finally below cannot run for process death).
+    beat_stop = threading.Event()
+
+    def _beat():
+        n = 0
+        while not beat_stop.is_set():
+            try:
+                store.set(_EBEAT_SCOPE, identity, str(n).encode())
+            except OSError:
+                pass  # driver gone: the job is over anyway
+            n += 1
+            beat_stop.wait(1.0)
+
+    threading.Thread(target=_beat, daemon=True,
+                     name=f"hvd-spark-beat-{index}").start()
+
     got = store.wait(_ECMD_SCOPE, [identity], timeout=start_timeout)
     env = json.loads(got[identity].decode())
     os.environ.update({k: str(v) for k, v in env.items()})
     os.environ.update({k: str(v) for k, v in extra_env.items()})
-    code = 0
+    code = 1  # anything that escapes assignment below counts as a crash
     try:
         result = fn(*args, **kwargs)
         store.set(_RESULT_SCOPE, identity, _dumps(result))
+        code = 0
     except SystemExit as e:
         # Preserve elastic exit semantics: the in-process machinery uses
         # a distinct TRANSIENT exit code for "my peer died, recycle me" —
         # flattening it to 1 would count the healthy survivor against the
-        # much stricter crash blacklist threshold.
-        code = int(e.code or 0)
-        raise
-    except BaseException:
-        code = 1
+        # much stricter crash blacklist threshold.  Non-integer codes are
+        # failure by Python convention (sys.exit("msg") == status 1).
+        code = 0 if e.code is None else \
+            (e.code if isinstance(e.code, int) else 1)
         raise
     finally:
+        beat_stop.set()
         store.set(_EEXIT_SCOPE, identity, str(code).encode())
     return index
 
@@ -258,7 +285,7 @@ def run_elastic(fn: Callable, args: tuple = (),
     hosts); returns the successful ranks' results."""
     from ..elastic.discovery import HostDiscovery, HostManager
     from ..elastic.driver import ElasticDriver
-    from ..elastic.registration import FAILURE, SUCCESS
+    from ..elastic.registration import FAILURE
     from ..runner.hosts import SlotInfo
     from ..transport.tcp import _default_advertise_addr
 
@@ -273,17 +300,37 @@ def run_elastic(fn: Callable, args: tuple = (),
     rdv_addr = _default_advertise_addr()
 
     class _SparkTaskDiscovery(HostDiscovery):
-        """Registered, not-yet-exited Spark task ATTEMPTS are the host
-        set (attempt-unique identities; see _elastic_task_fn)."""
+        """Registered, not-yet-exited, still-heartbeating Spark task
+        ATTEMPTS are the host set (attempt-unique identities; see
+        _elastic_task_fn).  The staleness check uses the DRIVER's
+        monotonic clock on counter changes, so a SIGKILLed executor —
+        which writes no exit marker — drops out of discovery once its
+        beat stops advancing."""
+
+        def __init__(self):
+            self._beats: Dict[str, tuple] = {}  # identity → (val, seen_at)
+
+        def _alive(self, identity: str) -> bool:
+            raw = server.get(_EBEAT_SCOPE, identity)
+            if raw is None:
+                return True  # just registered; beat thread starting up
+            now = time.monotonic()
+            prev = self._beats.get(identity)
+            if prev is None or prev[0] != raw:
+                self._beats[identity] = (raw, now)
+                return True
+            return now - prev[1] < _BEAT_STALE_SECS
 
         def find_available_hosts_and_slots(self) -> Dict[str, int]:
             return {identity: 1
                     for identity in server.keys(_REG_SCOPE)
-                    if server.get(_EEXIT_SCOPE, identity) is None}
+                    if server.get(_EEXIT_SCOPE, identity) is None
+                    and self._alive(identity)}
 
     driver = ElasticDriver(server, HostManager(_SparkTaskDiscovery()),
                            min_np=min_np, max_np=max_np or num_proc,
                            timeout=start_timeout)
+    assigned: Dict[str, SlotInfo] = {}  # identity → last assigned slot
 
     def create_worker(slot: SlotInfo, epoch: int) -> None:
         env = dict(slot.to_env())
@@ -294,19 +341,28 @@ def run_elastic(fn: Callable, args: tuple = (),
             env_mod.HOROVOD_ELASTIC: "1",
             "HOROVOD_EPOCH": str(epoch),
         })
+        assigned[slot.hostname] = slot
         server.set(_ECMD_SCOPE, slot.hostname, json.dumps(env).encode())
 
     monitor_stop = threading.Event()
+    rank_results: Dict[int, str] = {}  # rank → identity that succeeded
 
     def monitor():
+        # Walk ALL ever-assigned identities, not driver.current_slots: the
+        # discovery loop may prune a finished host before this thread's
+        # next tick, and a missed exit would lose its success/result.
         seen: set = set()
         while not monitor_stop.is_set():
-            for slot in driver.current_slots:
-                identity = f"{slot.hostname}:{slot.local_rank}"
-                raw = server.get(_EEXIT_SCOPE, slot.hostname)
-                if raw is not None and identity not in seen:
+            for identity, slot in list(assigned.items()):
+                if identity in seen:
+                    continue
+                raw = server.get(_EEXIT_SCOPE, identity)
+                if raw is not None:
                     seen.add(identity)
-                    driver.record_worker_exit(slot, int(raw.decode()))
+                    code = int(raw.decode())
+                    if code == 0:
+                        rank_results[slot.rank] = identity
+                    driver.record_worker_exit(slot, code)
             time.sleep(0.2)
 
     mapper = _make_elastic_mapper(fn, args, kwargs, rdv_addr, port, key,
@@ -331,24 +387,25 @@ def run_elastic(fn: Callable, args: tuple = (),
                          name="hvd-spark-elastic-mon").start()
         while True:
             time.sleep(0.3)
-            successes = driver._registry.count(SUCCESS)
             failures = driver._registry.count(FAILURE)
+            job_over = not job_thread.is_alive()
             all_exited = not driver.hosts.total_slots()
-            if successes and all_exited:
-                break  # every attempt done, at least one rank succeeded
-            if all_exited and failures and not successes:
+            if rank_results and (all_exited or job_over):
+                break  # attempts done; at least one rank succeeded
+            if (all_exited or job_over) and (failures or spark_err) \
+                    and not rank_results:
+                if spark_err:
+                    raise spark_err[0]
                 raise RuntimeError(
                     f"elastic spark job lost all capacity "
                     f"({failures} failures)")
-            if spark_err and not successes:
-                raise spark_err[0]
             if driver.stopped_error:
                 raise RuntimeError(driver.stopped_error)
         out: Dict[int, Any] = {}
-        for slot in driver.current_slots:
-            blob = server.get(_RESULT_SCOPE, slot.hostname)
+        for rank_, identity in rank_results.items():
+            blob = server.get(_RESULT_SCOPE, identity)
             if blob is not None:
-                out[slot.rank] = _loads(blob)
+                out[rank_] = _loads(blob)
         return [out[r] for r in sorted(out)]
     finally:
         monitor_stop.set()

@@ -106,3 +106,28 @@ print("VG_OK", rank)
 """)
     for r, o in enumerate(out):
         assert f"VG_OK {r}" in o
+
+
+def test_checkpoint_save_restore_broadcast(tmp_path):
+    """Rank 0 writes orbax, every rank restores the identical tree even
+    though only rank 0 reads storage (reference rank-0-checkpoint +
+    broadcast fan-out idiom, SURVEY §5.4)."""
+    out = run_distributed(2, f"""
+import jax.numpy as jnp
+import horovod_tpu.frameworks.jax.checkpoint as ckpt
+
+path = {str(tmp_path)!r} + "/state"
+state = {{"w": jnp.arange(4, dtype=jnp.float32) * (rank + 1),
+          "step": jnp.asarray(7)}}
+# only rank 0's state is durable; all ranks call save
+ckpt.save(path, state)
+assert ckpt.exists(path)
+restored = ckpt.restore(path)
+# every rank gets RANK 0's tree
+assert np.allclose(np.asarray(restored["w"]), np.arange(4)), restored
+assert int(restored["step"]) == 7
+assert not ckpt.exists(path + ".missing")
+print("CKPT_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"CKPT_OK {r}" in o
