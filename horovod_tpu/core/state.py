@@ -64,10 +64,12 @@ class HorovodGlobalState:
         self.timeline = None  # attached by core.timeline when enabled
         self.parameter_manager = None  # attached when autotune enabled
         self.cycle_count = 0
-        # Finalizer thread (reference gpu_operations.h:98-127): completes
-        # async device collectives so the negotiation loop never blocks.
-        self._finalize_queue: "queue.Queue" = queue.Queue()
-        self._finalizer: Optional[threading.Thread] = None
+        # Finalizer pool (reference gpu_operations.h:98-127 finalizer
+        # threads, one per stream via ThreadPool operations.cc:421):
+        # completes async device collectives so the negotiation loop never
+        # blocks; HOROVOD_NUM_FINALIZER_THREADS (NUM_NCCL_STREAMS analog)
+        # lets multiple in-flight fused batches finalize concurrently.
+        self._finalizer_pool = None
 
     # ------------------------------------------------------------------
 
@@ -245,11 +247,10 @@ class HorovodGlobalState:
             # reference draining the tensor table on shutdown.
             self._fail_all_pending("Horovod has been shut down")
         finally:
-            if self._finalizer is not None:
+            if self._finalizer_pool is not None:
                 # In-flight device work must complete (and fire callbacks)
                 # before shutdown is declared done.
-                self._finalize_queue.put(None)
-                self._finalizer.join(timeout=60)
+                self._finalizer_pool.shutdown(timeout=60)
             if self.mesh is not None:
                 self.mesh.close()
             if self.timeline is not None:
@@ -321,44 +322,39 @@ class HorovodGlobalState:
             # happens on the finalizer thread.
             self.timeline.op_end(response, entries)
         if status.pending:
-            # Async device work dispatched: the finalizer thread waits for
-            # readiness and fires the callbacks, so this loop moves straight
-            # on to the next negotiation cycle.
-            self._ensure_finalizer()
-            self._finalize_queue.put(entries)
+            # Async device work dispatched: a finalizer-pool worker waits
+            # for readiness and fires the callbacks, so this loop moves
+            # straight on to the next negotiation cycle.
+            if self._finalizer_pool is None:
+                from .thread_pool import ThreadPool
+
+                self._finalizer_pool = ThreadPool(
+                    env_mod.get_int("HOROVOD_NUM_FINALIZER_THREADS", 1),
+                    name="horovod-finalizer")
+            self._finalizer_pool.execute(
+                lambda ents=entries: self._finalize_entries(ents))
             return
         for e in entries:
             e.callback(status, e)
 
-    def _ensure_finalizer(self) -> None:
-        if self._finalizer is None:
-            self._finalizer = threading.Thread(
-                target=self._finalizer_loop, name="horovod-finalizer",
-                daemon=True)
-            self._finalizer.start()
+    @staticmethod
+    def _finalize_entries(entries) -> None:
+        try:
+            import jax
 
-    def _finalizer_loop(self) -> None:
-        while True:
-            item = self._finalize_queue.get()
-            if item is None:
-                return
-            entries = item
+            jax.block_until_ready(
+                [e.output for e in entries if e.output is not None])
+            status = Status.OK()
+        except Exception as e:  # noqa: BLE001
+            status = Status.error(f"XLA collective failed: {e}")
+        for e in entries:
             try:
-                import jax
-
-                jax.block_until_ready(
-                    [e.output for e in entries if e.output is not None])
-                status = Status.OK()
-            except Exception as e:  # noqa: BLE001
-                status = Status.error(f"XLA collective failed: {e}")
-            for e in entries:
-                try:
-                    e.callback(status, e)
-                except Exception:  # noqa: BLE001 — a raising callback must
-                    # not kill the finalizer (later collectives would hang
-                    # on a queue nobody drains)
-                    log.error("finalizer callback for %r raised",
-                              e.tensor_name, exc_info=True)
+                e.callback(status, e)
+            except Exception:  # noqa: BLE001 — a raising callback must
+                # not kill the finalizer worker (later collectives would
+                # strand on unfired callbacks)
+                log.error("finalizer callback for %r raised",
+                          e.tensor_name, exc_info=True)
 
     def _fail_all_pending(self, msg: str) -> None:
         # Close first: an add racing the drain must fail fast, not strand.

@@ -1,0 +1,51 @@
+"""Fixed-size worker pool.
+
+Role of the reference's ``common/thread_pool.h:45`` / ``thread_pool.cc:67``:
+a generic closure-executing pool, used there for the per-stream GPU
+finalizer threads (``operations.cc:421``).  Here it backs the XLA
+finalizer (``HOROVOD_NUM_FINALIZER_THREADS`` is the
+``HOROVOD_NUM_NCCL_STREAMS`` analog: more threads let multiple in-flight
+fused batches complete concurrently instead of serializing behind one
+``block_until_ready``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+
+class ThreadPool:
+    def __init__(self, num_threads: int, name: str = "hvd-pool"):
+        self._queue: "queue.Queue[Optional[Callable[[], None]]]" = \
+            queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._shutdown = False
+        for i in range(max(1, num_threads)):
+            t = threading.Thread(target=self._loop, name=f"{name}-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _loop(self) -> None:
+        while True:
+            task = self._queue.get()
+            if task is None:
+                return
+            task()  # tasks are pre-wrapped; they must not raise
+
+    def execute(self, fn: Callable[[], None]) -> None:
+        if self._shutdown:
+            raise RuntimeError("ThreadPool is shut down")
+        self._queue.put(fn)
+
+    def shutdown(self, timeout: Optional[float] = None) -> None:
+        """Drain: queued tasks run to completion, then workers exit."""
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for _ in self._threads:
+            self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=timeout)

@@ -248,12 +248,16 @@ def _elastic_task_fn(index: int, fn: Callable, args: tuple, kwargs: dict,
     threading.Thread(target=_beat, daemon=True,
                      name=f"hvd-spark-beat-{index}").start()
 
-    got = store.wait(_ECMD_SCOPE, [identity], timeout=start_timeout)
-    env = json.loads(got[identity].decode())
-    os.environ.update({k: str(v) for k, v in env.items()})
-    os.environ.update({k: str(v) for k, v in extra_env.items()})
+    # EVERYTHING after registration sits under one try/finally: a failure
+    # while waiting for the command (timeout, bad JSON) must still stop
+    # the beat and write an exit marker, or a reused Spark python worker
+    # would keep heartbeating as an immortal ghost host.
     code = 1  # anything that escapes assignment below counts as a crash
     try:
+        got = store.wait(_ECMD_SCOPE, [identity], timeout=start_timeout)
+        env = json.loads(got[identity].decode())
+        os.environ.update({k: str(v) for k, v in env.items()})
+        os.environ.update({k: str(v) for k, v in extra_env.items()})
         result = fn(*args, **kwargs)
         store.set(_RESULT_SCOPE, identity, _dumps(result))
         code = 0
@@ -261,10 +265,12 @@ def _elastic_task_fn(index: int, fn: Callable, args: tuple, kwargs: dict,
         # Preserve elastic exit semantics: the in-process machinery uses
         # a distinct TRANSIENT exit code for "my peer died, recycle me" —
         # flattening it to 1 would count the healthy survivor against the
-        # much stricter crash blacklist threshold.  Non-integer codes are
-        # failure by Python convention (sys.exit("msg") == status 1).
+        # much stricter crash blacklist threshold.  Non-integer codes
+        # (incl. bool) are failure by Python convention
+        # (sys.exit("msg") == status 1).
         code = 0 if e.code is None else \
-            (e.code if isinstance(e.code, int) else 1)
+            (e.code if isinstance(e.code, int)
+             and not isinstance(e.code, bool) else 1)
         raise
     finally:
         beat_stop.set()
@@ -311,9 +317,10 @@ def run_elastic(fn: Callable, args: tuple = (),
             self._beats: Dict[str, tuple] = {}  # identity → (val, seen_at)
 
         def _alive(self, identity: str) -> bool:
+            # A missing beat key gets the SAME staleness deadline from
+            # first sighting: an executor SIGKILLed before its first beat
+            # write must still age out of discovery.
             raw = server.get(_EBEAT_SCOPE, identity)
-            if raw is None:
-                return True  # just registered; beat thread starting up
             now = time.monotonic()
             prev = self._beats.get(identity)
             if prev is None or prev[0] != raw:
@@ -346,23 +353,29 @@ def run_elastic(fn: Callable, args: tuple = (),
 
     monitor_stop = threading.Event()
     rank_results: Dict[int, str] = {}  # rank → identity that succeeded
+    seen_exits: set = set()
+
+    def sweep_exits():
+        # Walk ALL ever-assigned identities, not driver.current_slots: the
+        # discovery loop may prune a finished host before the next tick,
+        # and a missed exit would lose its success/result.
+        for identity, slot in list(assigned.items()):
+            if identity in seen_exits:
+                continue
+            raw = server.get(_EEXIT_SCOPE, identity)
+            if raw is not None:
+                seen_exits.add(identity)
+                try:
+                    code = int(raw.decode())
+                except ValueError:
+                    code = 1  # garbage marker counts as a crash
+                if code == 0:
+                    rank_results[slot.rank] = identity
+                driver.record_worker_exit(slot, code)
 
     def monitor():
-        # Walk ALL ever-assigned identities, not driver.current_slots: the
-        # discovery loop may prune a finished host before this thread's
-        # next tick, and a missed exit would lose its success/result.
-        seen: set = set()
         while not monitor_stop.is_set():
-            for identity, slot in list(assigned.items()):
-                if identity in seen:
-                    continue
-                raw = server.get(_EEXIT_SCOPE, identity)
-                if raw is not None:
-                    seen.add(identity)
-                    code = int(raw.decode())
-                    if code == 0:
-                        rank_results[slot.rank] = identity
-                    driver.record_worker_exit(slot, code)
+            sweep_exits()
             time.sleep(0.2)
 
     mapper = _make_elastic_mapper(fn, args, kwargs, rdv_addr, port, key,
@@ -401,6 +414,10 @@ def run_elastic(fn: Callable, args: tuple = (),
                     f"({failures} failures)")
             if driver.stopped_error:
                 raise RuntimeError(driver.stopped_error)
+        # One last sweep: the break conditions (job thread done, discovery
+        # empty) race the monitor's 0.2s tick, and an exit marker written
+        # just before the break must still yield its rank's result.
+        sweep_exits()
         out: Dict[int, Any] = {}
         for rank_, identity in rank_results.items():
             blob = server.get(_RESULT_SCOPE, identity)
