@@ -15,6 +15,8 @@ never a requirement.
 from __future__ import annotations
 
 import ctypes
+import glob
+import hashlib
 import os
 import subprocess
 import threading
@@ -28,23 +30,46 @@ log = get_logger("horovod_tpu.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "native.cc")
-_SO = os.path.join(_DIR, "libhvdnative.so")
+
+
+def _so_path() -> Optional[str]:
+    # The built artifact is keyed on the source digest, not mtimes: git
+    # does not preserve mtimes, so after a clone a stale prebuilt .so and
+    # a newer native.cc can carry any timestamp ordering.  A content hash
+    # in the filename makes "source changed → rebuild" unconditional.
+    # When the source is unreadable (source-stripped wheel), fall back to
+    # any prebuilt artifact — the ABI probe still guards loading it — and
+    # to None (numpy paths) when there is neither; native is a pure
+    # accelerator, never a requirement.
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:12]
+        return os.path.join(_DIR, f"libhvdnative-{digest}.so")
+    except OSError:
+        prebuilt = sorted(glob.glob(os.path.join(_DIR, "libhvdnative*.so")))
+        return prebuilt[0] if prebuilt else None
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _build(so: str) -> bool:
     # Compile to a per-process temp name and rename into place: multiple
     # workers on one host race this on first use, and a peer dlopen-ing a
     # half-linked .so would SIGBUS mid-training.  rename() is atomic.
-    tmp = f"{_SO}.{os.getpid()}.tmp"
+    tmp = f"{so}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
            _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
+        for stale in glob.glob(os.path.join(_DIR, "libhvdnative*.so")):
+            if stale != so:
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
         return True
     except (OSError, subprocess.SubprocessError) as e:
         log.warning("native kernel build failed (%s); using numpy paths", e)
@@ -90,25 +115,27 @@ def lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("HOROVOD_DISABLE_NATIVE"):
             return None
-        needs_build = (not os.path.exists(_SO)
-                       or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
-        if needs_build and not _build():
+        so = _so_path()
+        if so is None:
             return None
-        _lib = _try_load()
+        needs_build = not os.path.exists(so)
+        if needs_build and not _build(so):
+            return None
+        _lib = _try_load(so)
         if _lib is None and not needs_build:
             # The existing .so may be foreign (wrong arch/glibc from a
             # copied checkout or prebuilt wheel); one rebuild attempt
             # before giving up on native for the process lifetime.
-            if _build():
-                _lib = _try_load()
+            if _build(so):
+                _lib = _try_load(so)
     return _lib
 
 
-def _try_load() -> Optional[ctypes.CDLL]:
+def _try_load(so: str) -> Optional[ctypes.CDLL]:
     try:
         # AttributeError covers a stale .so missing newer symbols —
         # native must degrade to numpy, never crash a collective.
-        cdll = _bind(ctypes.CDLL(_SO))
+        cdll = _bind(ctypes.CDLL(so))
         if cdll.hvd_native_abi_version() != 1:
             raise OSError("ABI version mismatch")
         return cdll

@@ -30,6 +30,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..common.exceptions import HorovodInternalError
 from ..common.topology import ProcessTopology
 from ..core.messages import DataType, Response, ResponseType
 from ..core.tensor_queue import Status, TensorTableEntry
@@ -322,7 +323,12 @@ class RingAllgather(CollectiveOp):
             recv_origin = (rank - s - 1) % size
             got = self.mesh.sendrecv(nxt, blocks[send_origin].tobytes(), prv)
             arr = np.frombuffer(got, dtype=dtype)
-            assert arr.size == block_elems(recv_origin)
+            if arr.size != block_elems(recv_origin):
+                # Loud failure (not assert: stripped under -O) — a corrupt
+                # frame or desynced negotiation must not mis-slice outputs.
+                raise HorovodInternalError(
+                    f"allgather ring block from rank {recv_origin}: got "
+                    f"{arr.size} elems, expected {block_elems(recv_origin)}")
             blocks[recv_origin] = arr
 
         for i, e in enumerate(entries):

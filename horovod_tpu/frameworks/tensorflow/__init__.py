@@ -19,6 +19,8 @@ IndexedSlices→allgather) match the reference.
 from __future__ import annotations
 
 import io
+import itertools
+import weakref
 from typing import Any, List, Optional
 
 import numpy as np
@@ -84,6 +86,24 @@ def _is_symbolic(tensor) -> bool:
             and not tf.executing_eagerly())
 
 
+def _unnamed_wire_name(tf) -> str:
+    """A wire name for a symbolic tensor with no usable ``.name``.
+
+    The counter is scoped to the graph being traced (not the process):
+    per-graph numbering is trace-order-independent across ranks the same
+    way tensor names are, so a rank that retraces one function more often
+    than a peer cannot desync the names of every later graph.
+    """
+    g = tf.compat.v1.get_default_graph()
+    counters = _unnamed_wire_name._per_graph
+    if g not in counters:
+        counters[g] = itertools.count()
+    return f"unnamed.{next(counters[g])}"
+
+
+_unnamed_wire_name._per_graph = weakref.WeakKeyDictionary()
+
+
 def _graph_collective(kind: str, tensor, name: Optional[str], eager_fn,
                       out_shape):
     """Run ``eager_fn`` (a numpy-level collective) under ``tf.py_function``
@@ -95,9 +115,16 @@ def _graph_collective(kind: str, tensor, name: Optional[str], eager_fn,
     that trace different step counts.
     """
     tf = _tf()
-    tname = getattr(tensor, "name", None) or "t"
-    fixed = name or f"tf.graph.{kind}." + \
-        "".join(c if c.isalnum() or c in "._" else "_" for c in tname)
+    if name:
+        fixed = name
+    else:
+        # Distinct unnamed tensors must get distinct wire names or their
+        # negotiation keys collide (shape-mismatch / cross-wired results).
+        # Only draw from the per-graph counter when actually needed, so
+        # named calls never advance it.
+        tname = getattr(tensor, "name", None) or _unnamed_wire_name(tf)
+        fixed = f"tf.graph.{kind}." + \
+            "".join(c if c.isalnum() or c in "._" else "_" for c in tname)
 
     def _run(t):
         return tf.convert_to_tensor(np.asarray(eager_fn(t.numpy(), fixed)))

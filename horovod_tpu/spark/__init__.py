@@ -10,10 +10,12 @@ spawned by ``hvdrun``.
 Differences from the reference: no mpirun/orted re-exec dance and no
 pickled-RPC service framework — each Spark task registers and fetches its
 rank table directly through the launcher's HMAC-signed rendezvous KV
-server (the secret rides the Spark closure, which Spark encrypts in
-transit, matching the reference's "Spark RPC communicates the key"
-approach — ``spark/runner.py:46-48``), and the user function runs in the
-task process itself.
+server (the secret rides the Spark closure — note Spark's RPC/closure
+transport is cleartext unless the cluster enables
+``spark.network.crypto.enabled`` or SSL, so enable one of those on
+untrusted networks; the reference's "Spark RPC communicates the key"
+approach, ``spark/runner.py:46-48``, has the same property), and the
+user function runs in the task process itself.
 
 ``import horovod_tpu.spark`` works without pyspark; ``run()`` accepts any
 SparkContext-shaped object (``parallelize(...).mapPartitionsWithIndex(...)

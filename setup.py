@@ -7,6 +7,7 @@ horovod_tpu/_native/__init__.py compiles on first use — but installed
 wheels should ship the prebuilt .so.
 """
 
+import hashlib
 import os
 import subprocess
 
@@ -28,18 +29,23 @@ class build_py_with_native(build_py):
         super().run()
         src = os.path.join(self.build_lib, "horovod_tpu", "_native",
                            "native.cc")
+        if not os.path.exists(src):
+            return
+        # Must match _native/__init__.py's hash-keyed artifact name so the
+        # loader accepts the wheel-built .so without a rebuild.
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:12]
         out = os.path.join(self.build_lib, "horovod_tpu", "_native",
-                           "libhvdnative.so")
-        if os.path.exists(src):
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     src, "-o", out],
-                    check=True, timeout=300)
-            except (OSError, subprocess.SubprocessError) as e:
-                # The package works without it (numpy fallbacks); don't
-                # fail installation on compiler-less hosts.
-                print(f"warning: native kernel build skipped: {e}")
+                           f"libhvdnative-{digest}.so")
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 src, "-o", out],
+                check=True, timeout=300)
+        except (OSError, subprocess.SubprocessError) as e:
+            # The package works without it (numpy fallbacks); don't
+            # fail installation on compiler-less hosts.
+            print(f"warning: native kernel build skipped: {e}")
 
 
 setup(cmdclass={"build_py": build_py_with_native},
