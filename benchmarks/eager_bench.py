@@ -1,0 +1,153 @@
+"""Eager product-path benchmark: jit step vs DistributedOptimizer step.
+
+The framework's core promise (SURVEY §7.4) is that the Horovod-style eager
+path — gradients enqueued as named tensors, negotiated by the background
+controller, fused, dispatched through the pre-compiled bucketed XLA
+collectives (`backend/xla.py`), results awaited via handles — costs ~nothing
+next to a pure-jit step.  This harness measures exactly that on whatever
+accelerator is attached, with the SAME model/batch/dtype as `bench.py`:
+
+- **jit**: one compiled train step, gradient sync folded in as a psum
+  (the configuration `bench.py` reports).
+- **eager**: the same jit'd forward/backward, but the gradient pytree flows
+  through ``hvd.DistributedOptimizer`` (full enqueue → negotiate → fuse →
+  device collective → unfuse → synchronize per step).  Run under
+  ``hvd.init()`` so the runtime is live; at np=1 the negotiation is local
+  but every other overhead source (host round-trips, fuse/unfuse dispatch,
+  handle waits, cycle latency) is real and measured.
+
+Output: one JSON object with both throughputs and the gap.  The driver's
+acceptance bar (VERDICT r2 #1) is gap ≤ ~10%.
+
+Run: ``python benchmarks/eager_bench.py [--batch-size N] [--iters N]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--image-size", type=int, default=None)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON result to this path")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.frameworks.jax.optimizer import DistributedOptimizer
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.models.training import (
+        create_train_state,
+        make_sharded_train_step,
+    )
+    from horovod_tpu.parallel import MeshSpec, build_mesh, shard_batch
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch_size = args.batch_size or (128 if on_tpu else 8)
+    image_size = args.image_size or (224 if on_tpu else 64)
+    warmup, iters = args.warmup, (args.iters if on_tpu else 5)
+
+    model = ResNet50(num_classes=1000,
+                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    tx = optax.sgd(0.01, momentum=0.9)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch_size, image_size, image_size, 3),
+                    jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, size=(batch_size,)), jnp.int32)
+
+    # ---- jit flavor (bench.py configuration) --------------------------
+    mesh = build_mesh(MeshSpec(data=-1))
+    state = create_train_state(model, jax.random.PRNGKey(0), x, tx,
+                               mesh=mesh, init_kwargs={"train": True})
+    step = make_sharded_train_step(model, tx, mesh, has_batch_stats=True,
+                                   donate=True)
+    batch = shard_batch(mesh, {"x": x, "y": y})
+    compiled = step.lower(state, batch).compile()
+
+    for _ in range(warmup):
+        state, loss = compiled(state, batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = compiled(state, batch)
+    float(loss)
+    jit_dt = (time.perf_counter() - t0) / iters
+    del state
+
+    # ---- eager flavor (the product path) ------------------------------
+    hvd.init()
+
+    estate = create_train_state(model, jax.random.PRNGKey(0), x, tx,
+                                init_kwargs={"train": True})
+    dopt = DistributedOptimizer(tx)
+    params, batch_stats = estate.params, estate.batch_stats
+    opt_state = dopt.init(params)
+
+    @jax.jit
+    def grad_step(params, batch_stats):
+        def loss_fn(p):
+            out, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x,
+                train=True, mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(y, 1000)
+            return optax.softmax_cross_entropy(out, one_hot).mean(), updates
+        (loss, updates), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, grads, updates["batch_stats"]
+
+    apply_updates = jax.jit(optax.apply_updates)
+
+    def eager_step():
+        nonlocal params, batch_stats, opt_state
+        loss, grads, batch_stats = grad_step(params, batch_stats)
+        updates, opt_state = dopt.update(grads, opt_state, params)
+        params = apply_updates(params, updates)
+        return loss
+
+    for _ in range(warmup):
+        loss = eager_step()
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = eager_step()
+    final_loss = float(loss)
+    eager_dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(final_loss)
+
+    from horovod_tpu.backend import xla as xla_backend
+    result = {
+        "metric": "eager_vs_jit_resnet50",
+        "batch_size": batch_size,
+        "image_size": image_size,
+        "iters": iters,
+        "world_size": hvd.size(),
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+        "jit_images_per_sec": round(batch_size / jit_dt, 2),
+        "eager_images_per_sec": round(batch_size / eager_dt, 2),
+        "jit_step_ms": round(jit_dt * 1e3, 3),
+        "eager_step_ms": round(eager_dt * 1e3, 3),
+        "eager_overhead_ms": round((eager_dt - jit_dt) * 1e3, 3),
+        "gap_pct": round((eager_dt - jit_dt) / jit_dt * 100, 2),
+        "xla_dispatch_stats": dict(xla_backend.stats),
+    }
+    hvd.shutdown()
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

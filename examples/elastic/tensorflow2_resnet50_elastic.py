@@ -50,7 +50,6 @@ def main():
 
     size = args.image_size if not args.full_resnet else 224
     model = build_model(tf, small=not args.full_resnet)
-    # scale LR by CURRENT world size; elastic resets re-enter here
     opt = tf.keras.optimizers.SGD(args.base_lr * hvd.size(), momentum=0.9)
     loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
 
@@ -67,19 +66,22 @@ def main():
         return float(loss)
 
     train_batch()  # build variables before state capture
-    import horovod_tpu as hvd_core
 
-    state = hvd_core.elastic.ObjectState(
-        batch=0, weights=[w for w in model.get_weights()])
+    # TensorFlowKerasState snapshots model + optimizer variables on every
+    # commit and broadcasts them after a reset (reference
+    # ``tensorflow/elastic.py:91-144``) — no hand-rolled weight lists.
+    state = hvd.elastic.TensorFlowKerasState(model, optimizer=opt, batch=0)
 
-    @hvd_core.elastic.run
+    @hvd.elastic.run
     def train(state):
-        model.set_weights(state.weights)
+        # Re-entered after every elastic reset: rescale the LR to the
+        # CURRENT world size (the linear-scaling rule tracks the live
+        # effective batch, reference keras LR-scaling idiom).
+        opt.learning_rate.assign(args.base_lr * hvd.size())
         while state.batch < args.batches:
             loss = train_batch()
             state.batch += 1
             if state.batch % args.commit_every == 0:
-                state.weights = [w for w in model.get_weights()]
                 state.commit()
                 if hvd.rank() == 0:
                     print(f"batch {state.batch} size={hvd.size()} "

@@ -222,6 +222,7 @@ def run(func: Callable) -> Callable:
         resets = 0
         skip_sync = False
         reinit_failures = 0
+        pending_reset = False
         while True:
             if not global_state().initialized.is_set():
                 try:
@@ -240,6 +241,13 @@ def run(func: Callable) -> Callable:
                     _teardown()
                     continue
                 reinit_failures = 0
+            if pending_reset:
+                # AFTER re-init (reference run_fn order: reset() then
+                # on_reset()): handlers see the NEW rank/size — e.g. an
+                # ElasticSampler reshards here, which matters on the
+                # skip-sync path where sync() won't run to do it.
+                state.on_reset()
+                pending_reset = False
             try:
                 if not skip_sync:
                     state.sync()
@@ -253,7 +261,7 @@ def run(func: Callable) -> Callable:
             if reset_limit is not None and resets >= reset_limit:
                 raise RuntimeError(
                     f"Exceeded elastic reset limit ({reset_limit})")
-            state.on_reset()
+            pending_reset = True
             _teardown()
 
     return wrapper

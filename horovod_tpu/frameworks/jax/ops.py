@@ -213,6 +213,11 @@ def size_or_one() -> int:
     return state.topo.size if state.topo is not None else 1
 
 
+def initialized() -> bool:
+    """True when ``hvd.init()`` has completed and the runtime is live."""
+    return global_state().topo is not None
+
+
 def poll(handle: int) -> bool:
     """True when the async op behind ``handle`` completed
     (reference ``mpi_ops_v2.cc:323``)."""

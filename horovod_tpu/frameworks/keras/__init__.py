@@ -142,3 +142,12 @@ __all__ = [
     "LearningRateWarmupCallback", "load_model",
     "Sum", "Average", "Adasum",
 ]
+
+
+def __getattr__(name):
+    # Lazy submodule (PEP 562): hvd.elastic.KerasState.
+    if name == "elastic":
+        import importlib
+
+        return importlib.import_module(".elastic", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

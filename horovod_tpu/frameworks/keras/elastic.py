@@ -1,0 +1,107 @@
+"""Elastic helpers for the Keras surface (reference
+``tensorflow/keras/elastic.py`` + ``_keras/elastic.py``): ``KerasState`` and
+three callbacks that keep an elastic :class:`State` object current while
+``model.fit`` runs — commit every N batches, mirror the running batch
+number (shrinking the first post-reset epoch by the batches already done),
+and mirror the epoch counter across resets.
+"""
+
+from __future__ import annotations
+
+from ...elastic import run  # noqa: F401
+from ..tensorflow.elastic import TensorFlowKerasState
+
+
+def _keras():
+    import tensorflow as tf
+
+    return tf.keras
+
+
+class KerasState(TensorFlowKerasState):
+    """Elastic state of a ``tf.keras`` model (reference
+    ``keras/elastic.py:22-31``)."""
+
+
+def CommitStateCallback(state, batches_per_commit: int = 1):
+    """Commits ``state`` every ``batches_per_commit`` batches and at every
+    epoch end (reference ``_keras/elastic.py:17-39``)."""
+    keras = _keras()
+
+    class _CommitState(keras.callbacks.Callback):
+        def __init__(self):
+            super().__init__()
+            self._remaining = batches_per_commit
+
+        def on_train_begin(self, logs=None):
+            self._remaining = batches_per_commit
+
+        def on_batch_end(self, batch, logs=None):
+            self._remaining -= 1
+            if self._remaining == 0:
+                state.commit()
+                self._remaining = batches_per_commit
+
+        def on_epoch_end(self, epoch, logs=None):
+            state.commit()
+
+    return _CommitState()
+
+
+def UpdateBatchStateCallback(state):
+    """Tracks ``state.batch``; after a reset, trims the first epoch's step
+    count by the batches already processed (reference
+    ``_keras/elastic.py:42-63``)."""
+    keras = _keras()
+
+    class _UpdateBatchState(keras.callbacks.Callback):
+        def __init__(self):
+            super().__init__()
+            self._steps_per_epoch = None
+
+        def on_train_begin(self, logs=None):
+            self._steps_per_epoch = None
+
+        def on_epoch_begin(self, epoch, logs=None):
+            if self.params.get("steps"):
+                if self._steps_per_epoch is None:
+                    self._steps_per_epoch = self.params["steps"]
+                self.params["steps"] = self._steps_per_epoch - state.batch
+
+        def on_batch_end(self, batch, logs=None):
+            state.batch = batch
+
+        def on_epoch_end(self, epoch, logs=None):
+            state.batch = 0
+
+    return _UpdateBatchState()
+
+
+def UpdateEpochStateCallback(state):
+    """Tracks ``state.epoch`` globally across resets: Keras restarts its
+    epoch count at 0 every ``fit``, so offset by the epoch carried in the
+    state (+1 so a reset right after an epoch end does not repeat it)
+    (reference ``_keras/elastic.py:66-87``)."""
+    keras = _keras()
+
+    class _UpdateEpochState(keras.callbacks.Callback):
+        def __init__(self):
+            super().__init__()
+            self._initial_epoch = state.epoch
+
+        def on_train_begin(self, logs=None):
+            self._initial_epoch = state.epoch
+
+        def on_epoch_end(self, epoch, logs=None):
+            state.epoch = self._initial_epoch + epoch + 1
+
+    return _UpdateEpochState()
+
+
+__all__ = [
+    "CommitStateCallback",
+    "KerasState",
+    "UpdateBatchStateCallback",
+    "UpdateEpochStateCallback",
+    "run",
+]

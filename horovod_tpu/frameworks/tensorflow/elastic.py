@@ -1,0 +1,118 @@
+"""Elastic training state for the TensorFlow surface.
+
+Role of the reference's ``tensorflow/elastic.py:60-220``:
+``TensorFlowKerasState`` (snapshot + broadcast of a Keras model's and
+optimizer's variables) and ``TensorFlowState`` (the same over a bare
+variable list), plus the ``run`` decorator.  This surface is TF2/eager —
+the graph-session variants of the reference (``bcast_object_fn(session=…)``)
+have no counterpart here because the binding itself is eager-first
+(``frameworks/tensorflow/__init__.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ...elastic import run  # noqa: F401  (re-export: @hvd.elastic.run)
+from ...elastic.state import ObjectState
+from . import broadcast_variables
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def _optimizer_variables(optimizer) -> List[Any]:
+    """Keras optimizers expose ``variables`` as a method (legacy) or a
+    property (keras 3)."""
+    v = getattr(optimizer, "variables", None)
+    if v is None:
+        return []
+    return list(v() if callable(v) else v)
+
+
+class TensorFlowKerasState(ObjectState):
+    """Elastic state of a built Keras model + optimizer (reference
+    ``tensorflow/elastic.py:91-144``).
+
+    ``save()`` snapshots every model/optimizer variable to an in-memory
+    tensor copy; ``restore()`` assigns them back; ``sync()`` broadcasts the
+    live variables from the coordinator and re-snapshots.
+    """
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        built = model.built if hasattr(model, "built") else True
+        if not built:
+            raise ValueError(
+                "Model must be built first. Run `model.build(input_shape)`.")
+        self.model = model
+        self.optimizer = optimizer if optimizer is not None \
+            else model.optimizer
+        if self.optimizer is None:
+            raise ValueError("no optimizer: pass one or compile the model")
+        self._save_weights()
+        super().__init__(**kwargs)
+
+    def _all_variables(self) -> List[Any]:
+        return list(self.model.variables) + _optimizer_variables(
+            self.optimizer)
+
+    def _save_weights(self) -> None:
+        tf = _tf()
+        self._snapshot = [tf.identity(v) for v in self._all_variables()]
+
+    def _load_weights(self) -> None:
+        for var, saved in zip(self._all_variables(), self._snapshot):
+            var.assign(saved)
+
+    def save(self) -> None:
+        self._save_weights()
+        super().save()
+
+    def restore(self) -> None:
+        self._load_weights()
+        super().restore()
+
+    def sync(self) -> None:
+        broadcast_variables(self._all_variables(), root_rank=0)
+        self._save_weights()
+        super().sync()
+
+
+class TensorFlowState(ObjectState):
+    """Elastic state over an explicit variable list (reference
+    ``tensorflow/elastic.py:160-220``)."""
+
+    def __init__(self, variables: Optional[List[Any]] = None, **kwargs):
+        tf = _tf()
+        if variables is None:
+            variables = tf.compat.v1.global_variables()
+        self.variables = list(variables)
+        self._save_vars()
+        super().__init__(**kwargs)
+
+    def _save_vars(self) -> None:
+        self._values = [v.numpy() for v in self.variables]
+
+    def save(self) -> None:
+        self._save_vars()
+        super().save()
+
+    def restore(self) -> None:
+        for var, value in zip(self.variables, self._values):
+            var.assign(value)
+        super().restore()
+
+    def sync(self) -> None:
+        broadcast_variables(self.variables, root_rank=0)
+        self._save_vars()
+        super().sync()
+
+
+__all__ = [
+    "TensorFlowKerasState",
+    "TensorFlowState",
+    "run",
+]

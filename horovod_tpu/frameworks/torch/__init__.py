@@ -396,6 +396,16 @@ def DistributedOptimizer(optimizer, named_parameters=None, compression=None,
         backward_passes_per_step=backward_passes_per_step, op=op)
 
 
+def __getattr__(name):
+    # Lazy submodule (PEP 562): ``hvd.elastic.TorchState`` works without
+    # importing torch for numpy-only users of this surface.
+    if name == "elastic":
+        import importlib
+
+        return importlib.import_module(".elastic", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "is_homogeneous",

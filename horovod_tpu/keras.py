@@ -3,3 +3,11 @@ Keras binding (reference exposes `horovod.keras`)."""
 
 from .frameworks.keras import *  # noqa: F401,F403
 from .frameworks.keras import __all__  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "elastic":
+        from .frameworks.keras import elastic
+
+        return elastic
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

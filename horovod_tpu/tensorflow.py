@@ -3,3 +3,11 @@ TensorFlow binding (reference exposes `horovod.tensorflow`)."""
 
 from .frameworks.tensorflow import *  # noqa: F401,F403
 from .frameworks.tensorflow import __all__  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "elastic":
+        from .frameworks.tensorflow import elastic
+
+        return elastic
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -3,3 +3,11 @@ PyTorch binding (reference exposes `horovod.torch`)."""
 
 from .frameworks.torch import *  # noqa: F401,F403
 from .frameworks.torch import __all__  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "elastic":
+        from .frameworks.torch import elastic
+
+        return elastic
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

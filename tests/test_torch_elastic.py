@@ -1,0 +1,261 @@
+"""Per-framework elastic state: TorchState / ElasticSampler /
+TensorFlowKerasState.
+
+Mirrors the reference's ``test/single/test_torch_elastic.py`` (state
+save/restore/sync, sampler resharding that skips processed indices) plus a
+2-process sync lane under the real launcher harness.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from horovod_tpu.frameworks.torch.elastic import (  # noqa: E402
+    ElasticSampler,
+    TorchState,
+)
+from tests.helpers import run_distributed  # noqa: E402
+
+
+@pytest.fixture
+def single_rank(monkeypatch):
+    """Pretend hvd is initialized with rank 0 / size 1 for in-process
+    tests (reference runs these under a real np=1 launcher)."""
+    import horovod_tpu.frameworks.torch as hvd_torch
+
+    monkeypatch.setattr(hvd_torch, "rank", lambda: 0)
+    monkeypatch.setattr(hvd_torch, "size", lambda: 1)
+
+
+class TestElasticSampler:
+    def test_full_epoch_partition(self, single_rank):
+        data = list(range(10))
+        s = ElasticSampler(data, shuffle=False)
+        assert len(s) == 10
+        assert list(iter(s)) == data
+
+    def test_two_rank_shards_are_disjoint_and_cover(self, monkeypatch):
+        import horovod_tpu.frameworks.torch as hvd_torch
+
+        monkeypatch.setattr(hvd_torch, "size", lambda: 2)
+        data = list(range(10))
+        shards = []
+        for r in range(2):
+            monkeypatch.setattr(hvd_torch, "rank", lambda r=r: r)
+            s = ElasticSampler(data, shuffle=False)
+            assert len(s) == 5
+            shards.append(list(iter(s)))
+        assert sorted(shards[0] + shards[1]) == data
+
+    def test_record_and_reshard_skips_processed(self, monkeypatch):
+        """The headline semantic (reference ``sampler.py:24-131``): after
+        processing some batches on 2 ranks, a reset to 1 rank hands out
+        exactly the unprocessed remainder."""
+        import horovod_tpu.frameworks.torch as hvd_torch
+
+        monkeypatch.setattr(hvd_torch, "rank", lambda: 0)
+        monkeypatch.setattr(hvd_torch, "size", lambda: 2)
+        data = list(range(12))
+        s = ElasticSampler(data, shuffle=False)
+        it = list(iter(s))
+        # process the first two batches of size 2 on this rank
+        s.record_batch(0, 2)
+        s.record_batch(1, 2)
+        processed = set(it[:4])
+        assert s.processed_indices == processed
+
+        # world shrinks to 1; simulate the sync union (only this rank's
+        # record survives) then reshard
+        monkeypatch.setattr(hvd_torch, "size", lambda: 1)
+        s.reset()
+        remaining = list(iter(s))
+        assert set(remaining) == set(data) - processed
+        assert len(s) == len(data) - len(processed)
+
+    def test_set_epoch_clears_processed(self, single_rank):
+        s = ElasticSampler(list(range(6)), shuffle=True, seed=3)
+        s.record_indices({0, 1, 2})
+        s.set_epoch(1)
+        assert s.processed_indices == set()
+        assert len(s) == 6
+
+    def test_shuffle_is_deterministic_across_ranks(self, monkeypatch):
+        import horovod_tpu.frameworks.torch as hvd_torch
+
+        monkeypatch.setattr(hvd_torch, "size", lambda: 2)
+        data = list(range(20))
+        orders = []
+        for r in range(2):
+            monkeypatch.setattr(hvd_torch, "rank", lambda r=r: r)
+            s = ElasticSampler(data, shuffle=True, seed=7)
+            s.set_epoch(2)
+            orders.append(list(iter(s)))
+        # same (seed, epoch) ⇒ same global permutation ⇒ disjoint shards
+        assert not (set(orders[0]) & set(orders[1]))
+
+    def test_state_dict_roundtrip(self, single_rank):
+        s = ElasticSampler(list(range(8)), shuffle=False)
+        s.record_indices({1, 5})
+        s.epoch = 3
+        blob = s.state_dict()
+        s2 = ElasticSampler(list(range(8)), shuffle=False)
+        s2.load_state_dict(blob)
+        assert s2.epoch == 3
+        assert s2.processed_indices == {1, 5}
+        assert set(iter(s2)) == set(range(8)) - {1, 5}
+
+
+class TestTorchStateSingle:
+    def _model_and_opt(self):
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        return model, opt
+
+    def test_save_restore_model_and_optimizer(self, single_rank):
+        model, opt = self._model_and_opt()
+        state = TorchState(model=model, optimizer=opt, batch=0, epoch=0)
+
+        before = {k: v.clone() for k, v in model.state_dict().items()}
+        # take a training step (mutates weights + momentum buffers)
+        loss = model(torch.ones(3, 4)).sum()
+        loss.backward()
+        opt.step()
+        state.batch = 7
+        assert any((before[k] != v).any()
+                   for k, v in model.state_dict().items())
+
+        state.restore()
+        for k, v in model.state_dict().items():
+            assert torch.equal(before[k], v)
+        # plain attributes roll back too
+        assert state.batch == 0
+
+    def test_commit_advances_snapshot(self, single_rank):
+        model, opt = self._model_and_opt()
+        state = TorchState(model=model, optimizer=opt, batch=0)
+        loss = model(torch.ones(3, 4)).sum()
+        loss.backward()
+        opt.step()
+        after = {k: v.clone() for k, v in model.state_dict().items()}
+        state.batch = 3
+        state.commit()
+
+        # new mutation, then restore → lands on the committed point
+        opt.zero_grad()
+        loss = model(torch.ones(3, 4)).sum()
+        loss.backward()
+        opt.step()
+        state.restore()
+        for k, v in model.state_dict().items():
+            assert torch.equal(after[k], v)
+        assert state.batch == 3
+
+    def test_reassign_handled_attribute(self, single_rank):
+        model, opt = self._model_and_opt()
+        state = TorchState(model=model, optimizer=opt)
+        new_model = torch.nn.Linear(4, 2)
+        state.model = new_model
+        assert state._handlers["model"].value is new_model
+        # restore now targets the new model's snapshot
+        snap = {k: v.clone() for k, v in new_model.state_dict().items()}
+        with torch.no_grad():
+            new_model.weight.add_(1.0)
+        state.restore()
+        for k, v in new_model.state_dict().items():
+            assert torch.equal(snap[k], v)
+
+    def test_sampler_in_state_roundtrip(self, single_rank):
+        model, opt = self._model_and_opt()
+        sampler = ElasticSampler(list(range(10)), shuffle=False)
+        state = TorchState(model=model, optimizer=opt, sampler=sampler)
+        list(iter(sampler))
+        sampler.record_batch(0, 4)
+        state.commit()
+        sampler.record_batch(1, 4)
+        state.restore()
+        assert sampler.processed_indices == set(range(4))
+
+
+def test_torch_state_sync_two_ranks():
+    """Under the real launcher: rank-dependent weights + processed sets;
+    sync() must equalize on rank-0 weights and union the indices."""
+    body = textwrap.dedent("""
+    import torch
+    from horovod_tpu.frameworks.torch.elastic import ElasticSampler, TorchState
+
+    torch.manual_seed(rank)
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    sampler = ElasticSampler(list(range(8)), shuffle=False)
+    list(iter(sampler))
+    sampler.record_indices({rank, rank + 4})
+    state = TorchState(model=model, optimizer=opt, sampler=sampler, batch=rank)
+
+    state.sync()
+
+    # model weights equal rank 0's
+    torch.manual_seed(0)
+    ref = torch.nn.Linear(3, 2)
+    for a, b in zip(model.parameters(), ref.parameters()):
+        assert torch.allclose(a.data, b.data), (rank, a, b)
+    # processed indices are the union of all ranks'
+    assert sampler.processed_indices == {0, 1, 4, 5}, sampler.processed_indices
+    # plain attrs broadcast from rank 0
+    assert state.batch == 0
+    print("SYNC_OK", rank)
+    """)
+    outs = run_distributed(2, body, timeout=180)
+    for out in outs:
+        assert "SYNC_OK" in out
+
+
+def test_tensorflow_keras_state_save_restore():
+    tf = pytest.importorskip("tensorflow")
+    from horovod_tpu.frameworks.tensorflow.elastic import TensorFlowKerasState
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(2, input_shape=(3,))])
+    opt = tf.keras.optimizers.SGD(learning_rate=0.1)
+    model.compile(optimizer=opt, loss="mse")
+    model.build((None, 3))
+
+    state = TensorFlowKerasState(model, optimizer=opt, batch=0, epoch=0)
+    before = [v.numpy().copy() for v in model.variables]
+
+    model.variables[0].assign_add(tf.ones_like(model.variables[0]))
+    state.epoch = 5
+    state.restore()
+
+    import numpy as np
+    for b, v in zip(before, model.variables):
+        assert np.allclose(b, v.numpy())
+    assert state.epoch == 0
+
+
+def test_keras_elastic_callbacks_exist():
+    pytest.importorskip("tensorflow")
+    from horovod_tpu.frameworks.keras import elastic as kel
+
+    class Box:
+        epoch = 0
+        batch = 0
+
+        def commit(self):
+            self.committed = True
+
+    state = Box()
+    cbs = [kel.CommitStateCallback(state, batches_per_commit=2),
+           kel.UpdateBatchStateCallback(state),
+           kel.UpdateEpochStateCallback(state)]
+    for cb in cbs:
+        assert hasattr(cb, "on_epoch_end")
+    cbs[0].on_batch_end(0)
+    cbs[0].on_batch_end(1)
+    assert getattr(state, "committed", False)
+    cbs[2].on_epoch_end(0)
+    assert state.epoch == 1
