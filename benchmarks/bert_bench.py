@@ -28,6 +28,9 @@ def main() -> int:
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=None)
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks (less HBM traffic, more "
+                        "FLOPs — wins when the step is memory-bound)")
     args = p.parse_args()
 
     import jax
@@ -44,8 +47,9 @@ def main() -> int:
     on_tpu = jax.devices()[0].platform == "tpu"
     bs = args.batch_size or (8 if on_tpu else 2)
     seq = args.seq_len or (512 if on_tpu else 32)
-    cfg = bert_large_config(max_len=seq, causal=False) if on_tpu \
-        else tiny_config(max_len=seq, causal=False)
+    cfg = bert_large_config(max_len=seq, causal=False,
+                            remat=args.remat) if on_tpu \
+        else tiny_config(max_len=seq, causal=False, remat=args.remat)
     model = Transformer(cfg)
     tx = optax.adamw(1e-4)
 

@@ -1,0 +1,204 @@
+"""Capture + summarize a device profile of the headline train steps.
+
+Produces the per-op-class breakdown VERDICT r2 asked for: captures a
+``jax.profiler.trace`` around N steady-state steps of the ResNet-50 (or
+BERT) benchmark config, then parses the chrome-trace into device-time
+shares by fused-op class and prints a roofline table (XLA cost-model bytes
+vs HBM bandwidth, FLOPs vs MXU peak).
+
+Run on the chip:  ``python benchmarks/profile_step.py [--model bert]
+[--out benchmarks/results/resnet50_profile_v5e.json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _peak_for  # noqa: E402
+
+# v5e HBM bandwidth, public spec sheet (GB/s).
+_HBM_BW = {"v5 lite": 819e9, "v5e": 819e9, "v4": 1228e9, "v5p": 2765e9}
+
+
+def _bw_for(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, bw in _HBM_BW.items():
+        if key in kind:
+            return bw
+    return None
+
+
+def parse_trace(trace_dir: str, steps: int) -> dict:
+    """Device-time by op class from the chrome trace (pid of the TPU
+    device lane; outer jit spans and per-step lanes excluded)."""
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    data = json.load(gzip.open(sorted(paths)[-1]))
+    events = data["traceEvents"]
+    device_pids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "device" in e["args"].get("name", "").lower()
+    }
+    groups: dict = collections.defaultdict(float)
+    leaf_total = 0.0
+    for e in events:
+        if e.get("ph") == "X" and e["pid"] in device_pids:
+            name = e.get("name", "")
+            if name.startswith("jit_") or name.isdigit():
+                continue  # outer span / per-step lane, not a kernel
+            dur = e.get("dur", 0)
+            leaf_total += dur
+            groups[re.sub(r"[.\d]+$", "", name)] += dur
+    out = {
+        "device_ms_per_step": round(leaf_total / steps / 1e3, 3),
+        "classes": {
+            k: {"ms_per_step": round(v / steps / 1e3, 3),
+                "share": round(v / leaf_total, 4)}
+            for k, v in sorted(groups.items(), key=lambda kv: -kv[1])
+            if v / leaf_total > 0.004
+        },
+    }
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet", choices=["resnet", "bert"])
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    if args.model == "resnet":
+        from horovod_tpu.models import ResNet50
+        from horovod_tpu.models.training import (
+            create_train_state,
+            make_sharded_train_step,
+        )
+        from horovod_tpu.parallel import MeshSpec, build_mesh, shard_batch
+
+        bs = args.batch_size or (128 if on_tpu else 8)
+        size = 224 if on_tpu else 64
+        mesh = build_mesh(MeshSpec(data=-1))
+        model = ResNet50(num_classes=1000,
+                         dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+        tx = optax.sgd(0.01, momentum=0.9)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(bs, size, size, 3), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 1000, (bs,)), jnp.int32)
+        batch = shard_batch(mesh, {"x": x, "y": y})
+        state = create_train_state(model, jax.random.PRNGKey(0), x, tx,
+                                   mesh=mesh, init_kwargs={"train": True})
+        step = make_sharded_train_step(model, tx, mesh,
+                                       has_batch_stats=True, donate=True)
+        compiled = step.lower(state, batch).compile()
+        carry = (state,)
+
+        def run_once(carry):
+            state, = carry
+            state, loss = compiled(state, batch)
+            return (state,), loss
+    else:
+        from horovod_tpu.models.transformer import (
+            Transformer,
+            bert_large_config,
+            tiny_config,
+        )
+
+        bs = args.batch_size or (8 if on_tpu else 2)
+        seq = 512 if on_tpu else 32
+        cfg = bert_large_config(max_len=seq, causal=False) if on_tpu \
+            else tiny_config(max_len=seq, causal=False)
+        model = Transformer(cfg)
+        tx = optax.adamw(1e-4)
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)),
+                             jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        opt_state = tx.init(params)
+
+        def loss_fn(params, toks):
+            logits = model.apply({"params": params}, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, toks).mean()
+
+        def stepf(params, opt_state, toks):
+            loss, grads = jax.value_and_grad(loss_fn)(params, toks)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        compiled = jax.jit(stepf, donate_argnums=(0, 1)).lower(
+            params, opt_state, tokens).compile()
+        carry = (params, opt_state)
+
+        def run_once(carry):
+            params, opt_state = carry
+            params, opt_state, loss = compiled(params, opt_state, tokens)
+            return (params, opt_state), loss
+
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0))
+    byts = float(ca.get("bytes accessed", 0))
+
+    for _ in range(3):
+        carry, loss = run_once(carry)
+    float(loss)
+
+    tmp = tempfile.mkdtemp(prefix="hvdprof-")
+    with jax.profiler.trace(tmp):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            carry, loss = run_once(carry)
+        float(loss)
+        dt = (time.perf_counter() - t0) / args.steps
+
+    report = parse_trace(tmp, args.steps)
+    dev = jax.devices()[0]
+    peak = _peak_for(dev) if on_tpu else None
+    bw = _bw_for(dev) if on_tpu else None
+    report.update({
+        "model": args.model,
+        "batch_size": bs,
+        "device": getattr(dev, "device_kind", "cpu"),
+        "measured_ms_per_step": round(dt * 1e3, 3),
+        "cost_model_flops_per_step": flops,
+        "cost_model_bytes_per_step": byts,
+        "roofline": {
+            "compute_floor_ms": round(flops / peak * 1e3, 2) if peak else None,
+            "memory_floor_ms": round(byts / bw * 1e3, 2) if bw else None,
+            "bound": (("memory" if byts / bw > flops / peak else "compute")
+                      if (peak and bw) else None),
+            "mfu": round(flops / dt / peak, 4) if peak else None,
+        },
+    })
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,8 +5,9 @@ pretraining" — the reference benchmarks Adasum on BERT-large; role of
 Masked-LM pretraining on synthetic token streams with the repo's
 Transformer (``--bert-large`` selects the real 24-layer/1024-d config;
 default is a CI-sized model with identical code paths) and the jax
-``DistributedOptimizer(op=Adasum)``: gradients merge with the
-scale-insensitive Adasum operator instead of plain averaging, which keeps
+``DistributedOptimizer(op=Adasum)``: the factory returns the delta-space
+Adasum optimizer (reference parity) — each rank steps locally and the
+parameter deltas merge with the scale-insensitive Adasum operator, which keeps
 the large effective learning rates of big-batch pretraining stable.
 
 Run: ``hvdrun -np 4 python examples/adasum/adasum_bert_pretraining.py``

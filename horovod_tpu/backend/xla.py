@@ -175,7 +175,12 @@ class XlaContext:
             return jax.jit(f)
 
         fused = self._get(key, build)(*[e.tensor for e in entries])
-        return jax.device_put(fused, self.device)
+        # jit outputs land on the default device; only re-place when that
+        # is not this rank's mesh device (device_put on an in-flight array
+        # is a dependent dispatch — a full round trip on remote backends).
+        if fused.devices() != {self.device}:
+            fused = jax.device_put(fused, self.device)
+        return fused
 
     def unfuse(self, buf: Any, entries: List[TensorTableEntry]) -> None:
         """Local unfuse: slice the (local, replicated) result buffer back
@@ -246,6 +251,76 @@ class XlaContext:
                 if postscale != 1.0:
                     s = s * postscale
                 return s.astype(dt)
+
+            return jax.jit(f, in_shardings=(in_sh,), out_shardings=rep)
+
+        return self._get(key, build)
+
+    def local_allreduce(self, entries: List[TensorTableEntry], np_dtype,
+                        prescale: float, postscale: float) -> tuple:
+        """size==1 allreduce: one jit, straight from entry tensors to
+        per-entry outputs (sum over one rank is identity × scales).  No
+        fuse buffer, no mesh resharding — a single dispatch keeps the
+        host→device chain one hop deep, which matters on remote backends
+        where every dependent dispatch costs a round trip."""
+        import jax
+        import jax.numpy as jnp
+
+        shapes = tuple(tuple(e.tensor.shape) for e in entries)
+        key = ("ar.local", shapes, str(np_dtype), prescale, postscale)
+
+        def build():
+            dt = np.dtype(np_dtype)
+            widen = dt.itemsize <= 2 and jnp.issubdtype(dt, jnp.floating)
+            scale = prescale * postscale
+
+            def f(*ts):
+                outs = []
+                for t in ts:
+                    acc = t.astype(jnp.float32) if widen else t
+                    if scale != 1.0:
+                        acc = acc * scale
+                    outs.append(acc.astype(dt))
+                return tuple(outs)
+
+            return jax.jit(f)
+
+        return self._get(key, build)(*[e.tensor for e in entries])
+
+    def allreduce_unfuse_fn(self, shapes: Tuple, bucket: int, np_dtype,
+                            prescale: float, postscale: float) -> Callable:
+        """[P, bucket] sharded → tuple of per-entry replicated outputs:
+        the cross-process AllReduce and the unfuse slicing in ONE compiled
+        computation (halves the dependent-dispatch chain vs psum-then-
+        unfuse as separate jits)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("ar.fused", shapes, bucket, str(np_dtype), prescale,
+               postscale)
+
+        def build():
+            in_sh = NamedSharding(self.mesh, P("proc"))
+            rep = NamedSharding(self.mesh, P())
+            dt = np.dtype(np_dtype)
+            widen = dt.itemsize <= 2 and jnp.issubdtype(dt, jnp.floating)
+
+            def f(x):
+                acc = x.astype(jnp.float32) if widen else x
+                if prescale != 1.0:
+                    acc = acc * prescale
+                s = jnp.sum(acc, axis=0)
+                if postscale != 1.0:
+                    s = s * postscale
+                s = s.astype(dt)
+                outs = []
+                off = 0
+                for shp in shapes:
+                    n = int(np.prod(shp)) if shp else 1
+                    outs.append(s[off:off + n].reshape(shp))
+                    off += n
+                return tuple(outs)
 
             return jax.jit(f, in_shardings=(in_sh,), out_shardings=rep)
 
@@ -389,21 +464,35 @@ class XlaAllreduce(XlaOp):
                 entries: List[TensorTableEntry]) -> Status:
         ctx = self.ctx
         np_dtype = response.tensor_type.to_numpy()
-        total = sum(int(np.prod(e.tensor.shape)) if e.tensor.shape else 1
-                    for e in entries)
-        bucket = bucket_elems(total)
-        fused = ctx.fuse(entries, bucket, np_dtype)
-        fn = ctx.allreduce_fn(bucket, np_dtype, response.prescale_factor,
-                              response.postscale_factor)
-        out = fn(ctx.global_input(fused))
-        ctx.unfuse(ctx.local_view(out), entries)
+        if self.topo.size == 1:
+            outs = ctx.local_allreduce(entries, np_dtype,
+                                       response.prescale_factor,
+                                       response.postscale_factor)
+        else:
+            total = sum(int(np.prod(e.tensor.shape)) if e.tensor.shape else 1
+                        for e in entries)
+            bucket = bucket_elems(total)
+            shapes = tuple(tuple(e.tensor.shape) for e in entries)
+            fused = ctx.fuse(entries, bucket, np_dtype)
+            fn = ctx.allreduce_unfuse_fn(shapes, bucket, np_dtype,
+                                         response.prescale_factor,
+                                         response.postscale_factor)
+            outs = fn(ctx.global_input(fused))
+        for e, o in zip(entries, outs):
+            e.output = o
         _count("allreduce")
-        return Status.in_progress()
+        return Status.dispatched()
 
 
 class XlaAllgather(XlaOp):
-    """Variable-dim0 allgather: pad each rank's payload into a bucket row,
-    XLA AllGather, slice + concat locally (MPI_Allgatherv role)."""
+    """Variable-dim0 allgather (MPI_Allgatherv role): the whole fused
+    response rides ONE device AllGather — each entry's payload pads into
+    its own power-of-two segment of a shared row, every rank contributes
+    its row, and one compiled unpack slices all entries' outputs from the
+    replicated [P, row] result.  Wire bytes equal the per-entry-bucket sum
+    (same padding as per-entry dispatches), with a single dispatch per
+    response (reference fused-allgather role,
+    ``collective_operations.h:140-176``)."""
 
     def enabled(self, response: Response,
                 entries: List[TensorTableEntry]) -> bool:
@@ -412,52 +501,89 @@ class XlaAllgather(XlaOp):
 
     def execute(self, response: Response,
                 entries: List[TensorTableEntry]) -> Status:
-        # Fused responses dispatch one bucketed device collective per
-        # entry: unlike the host ring, padding k variable-dim0 tensors into
-        # one bucket row would inflate the wire bytes past what per-entry
-        # buckets cost, and the compiled-fn cache already absorbs the
-        # per-dispatch overhead.
-        size = self.topo.size
-        for i, entry in enumerate(entries):
-            self._gather_one(
-                response, entry,
-                list(response.tensor_sizes[i * size:(i + 1) * size]))
-        _count("allgather")
-        return Status.in_progress()
-
-    def _gather_one(self, response: Response, entry: TensorTableEntry,
-                    dim0s: List[int]) -> None:
         import jax
 
         ctx = self.ctx
+        size = self.topo.size
         np_dtype = response.tensor_type.to_numpy()
-        inner = tuple(entry.tensor.shape[1:])
-        inner_n = int(np.prod(inner)) if inner else 1
-        bucket = bucket_elems(max(d * inner_n for d in dim0s))
+        dim0s = [list(response.tensor_sizes[i * size:(i + 1) * size])
+                 for i in range(len(entries))]
+        inners = tuple(tuple(e.tensor.shape[1:]) for e in entries)
+        inner_ns = [int(np.prod(s)) if s else 1 for s in inners]
+        # Per-entry segment: bucket over the LARGEST rank's payload, so
+        # the row layout is identical on every rank.
+        seg = [bucket_elems(max(d) * n) if max(d) else _MIN_BUCKET
+               for d, n in zip(dim0s, inner_ns)]
+        offs = np.concatenate([[0], np.cumsum(seg)])
+        row = int(offs[-1])
+        matrix_key = tuple(tuple(d) for d in dim0s)
 
-        fused = ctx.fuse([entry], bucket, np_dtype)
-        out = ctx.allgather_fn(bucket, np_dtype)(ctx.global_input(fused))
-        local = ctx.local_view(out)  # [P, bucket] on this device
+        my_shapes = tuple(tuple(e.tensor.shape) for e in entries)
+        pack_key = ("ag.pack", my_shapes, matrix_key, str(np_dtype))
 
-        key = ("ag.unpack", tuple(dim0s), inner, str(np_dtype), bucket)
-
-        def build():
+        def build_pack():
             import jax.numpy as jnp
 
-            def f(x):
-                parts = [x[r, :dim0s[r] * inner_n].reshape((dim0s[r],) + inner)
-                         for r in range(len(dim0s))]
-                return jnp.concatenate(parts, axis=0)
+            def f(*ts):
+                buf = []
+                for t, s in zip(ts, seg):
+                    flat = t.ravel()
+                    buf.append(jnp.pad(flat, (0, s - flat.shape[0])))
+                return jnp.concatenate(buf) if len(buf) > 1 else buf[0]
+
             return jax.jit(f)
 
-        entry.output = ctx._get(key, build)(local)
+        local = ctx._get(pack_key, build_pack)(*[e.tensor for e in entries])
+        if local.devices() != {ctx.device}:
+            local = jax.device_put(local, ctx.device)
+
+        unpack_key = ("ag.gather", matrix_key, inners, str(np_dtype))
+
+        def build_unpack():
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            in_sh = NamedSharding(ctx.mesh, P("proc"))
+            rep = NamedSharding(ctx.mesh, P())
+
+            def f(x):  # [P, row] sharded → per-entry concatenated outputs
+                outs = []
+                for i, inner in enumerate(inners):
+                    parts = [
+                        x[r, offs[i]:offs[i] + dim0s[i][r] * inner_ns[i]]
+                        .reshape((dim0s[i][r],) + inner)
+                        for r in range(size)
+                    ]
+                    outs.append(jnp.concatenate(parts, axis=0)
+                                if size > 1 else parts[0])
+                return tuple(outs)
+
+            return jax.jit(f, in_shardings=(in_sh,), out_shardings=rep)
+
+        outs = ctx._get(unpack_key, build_unpack)(ctx.global_input(local))
+        for e, o in zip(entries, outs):
+            e.output = o
+        _count("allgather")
+        return Status.dispatched()
 
 
 class XlaAlltoall(XlaOp):
     """Uneven-splits alltoall on the device mesh (NCCLAlltoall /
-    MPI_Alltoallv role): each (src → dst) block pads into a fixed bucket
-    row, one XLA AllToAll moves the [P, P, bucket] row-blocks, and the
-    receiver slices its blocks back out by the negotiated split matrix."""
+    MPI_Alltoallv role).
+
+    Two lowerings, chosen by hardware:
+
+    - **TPU**: ``lax.ragged_all_to_all`` under ``shard_map`` — exact bytes
+      on the wire, no padding at all (the op XLA grew precisely for uneven
+      MoE-style exchanges).  Falls back automatically if the platform
+      rejects it.
+    - **Elsewhere (CPU tests / virtual meshes)**: each (src → dst) block
+      pads into a fixed bucket row and one uniform XLA AllToAll moves the
+      [P, P, bucket] row-blocks (ragged-all-to-all is unimplemented on
+      XLA:CPU).
+    """
+
+    _ragged_broken = False  # sticky per-process platform capability probe
 
     def enabled(self, response: Response,
                 entries: List[TensorTableEntry]) -> bool:
@@ -480,6 +606,20 @@ class XlaAlltoall(XlaOp):
         entry.received_splits = recv_splits
         inner = tuple(entry.tensor.shape[1:])
         inner_n = int(np.prod(inner)) if inner else 1
+
+        if (not XlaAlltoall._ragged_broken
+                and getattr(ctx.device, "platform", "") == "tpu"):
+            try:
+                entry.output = self._ragged(ctx, entry, matrix, inner,
+                                            inner_n, np_dtype)
+                _count("alltoall")
+                _count("alltoall_ragged")
+                return Status.dispatched()
+            except Exception as e:  # noqa: BLE001 — platform capability
+                log.warning("ragged_all_to_all unavailable (%s); using "
+                            "bucketed AllToAll", e)
+                XlaAlltoall._ragged_broken = True
+
         bucket = bucket_elems(max(max(matrix, default=1), 1) * inner_n)
 
         pack_key = ("a2a.pack", tuple(send_splits), inner,
@@ -519,7 +659,82 @@ class XlaAlltoall(XlaOp):
 
         entry.output = ctx._get(unpack_key, build_unpack)(mine)
         _count("alltoall")
-        return Status.in_progress()
+        return Status.dispatched()
+
+    def _ragged(self, ctx: XlaContext, entry: TensorTableEntry,
+                matrix: List[int], inner: Tuple, inner_n: int,
+                np_dtype) -> Any:
+        """Exact-bytes uneven alltoall via ``lax.ragged_all_to_all`` under
+        ``shard_map``.  Buffers pad to per-rank row maxima (rectangular
+        shardings need uniform caps) but the WIRE carries exactly the
+        negotiated split sizes — no O(P²·max-bucket) inflation.
+
+        Capability note: the first dispatch compiles on every rank of a
+        homogeneous TPU job, so the fallback flag flips on all ranks
+        together (platform support cannot differ mid-job)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        size, rank = self.topo.size, self.topo.rank
+        m = np.asarray(matrix, np.int64).reshape(size, size)
+        in_cap = max(int(m.sum(axis=1).max()), 1) * inner_n
+        out_cap = max(int(m.sum(axis=0).max()), 1) * inner_n
+
+        key = ("a2a.ragged", tuple(matrix), inner, str(np_dtype))
+
+        def build():
+            from jax import shard_map
+
+            elems = m * inner_n
+            in_offs = np.zeros((size, size), np.int32)
+            in_offs[:, 1:] = np.cumsum(elems[:, :-1], axis=1)
+            send_sz = elems.astype(np.int32)
+            out_offs = np.zeros((size, size), np.int32)
+            out_offs[1:, :] = np.cumsum(elems[:-1, :], axis=0)
+            recv_sz = elems.T.astype(np.int32)
+
+            def f(x):  # [1, in_cap] local block
+                i = jax.lax.axis_index("proc")
+                out = jnp.zeros((out_cap,), x.dtype)
+                res = jax.lax.ragged_all_to_all(
+                    x.reshape(-1), out,
+                    jnp.asarray(in_offs)[i], jnp.asarray(send_sz)[i],
+                    jnp.asarray(out_offs)[i], jnp.asarray(recv_sz)[i],
+                    axis_name="proc")
+                return res.reshape(1, out_cap)
+
+            return jax.jit(shard_map(
+                f, mesh=ctx.mesh, in_specs=P("proc"), out_specs=P("proc")))
+
+        send_splits = [int(v) for v in m[rank]]
+        pack_key = ("a2a.ragged.pack", tuple(send_splits), inner,
+                    str(np_dtype), in_cap)
+
+        def build_pack():
+            def f(x):
+                flat = x.reshape(-1)
+                return jnp.pad(flat, (0, in_cap - flat.shape[0]))
+
+            return jax.jit(f)
+
+        local = ctx._get(pack_key, build_pack)(entry.tensor)
+        if local.devices() != {ctx.device}:
+            local = jax.device_put(local, ctx.device)
+        out = ctx._get(key, build)(ctx.rows_input(local))
+        mine = ctx.local_view(out).reshape(-1)
+
+        total_recv = int(m[:, rank].sum())
+        unpack_key = ("a2a.ragged.unpack", total_recv, inner,
+                      str(np_dtype), out_cap)
+
+        def build_unpack():
+            def f(x):
+                return x[:total_recv * inner_n].reshape((total_recv,) + inner)
+
+            return jax.jit(f)
+
+        return ctx._get(unpack_key, build_unpack)(mine)
 
 
 class XlaBroadcast(XlaOp):
@@ -543,4 +758,4 @@ class XlaBroadcast(XlaOp):
         out = fn(ctx.global_input(fused))
         ctx.unfuse(ctx.local_view(out), [entry])
         _count("broadcast")
-        return Status.in_progress()
+        return Status.dispatched()

@@ -70,6 +70,9 @@ class HorovodGlobalState:
         # blocks; HOROVOD_NUM_FINALIZER_THREADS (NUM_NCCL_STREAMS analog)
         # lets multiple in-flight fused batches finalize concurrently.
         self._finalizer_pool = None
+        # Sticky failure from the eager-complete watchdog (NCCL
+        # async-error-watchdog role): raised by the next enqueue.
+        self.async_error: Optional[str] = None
 
     # ------------------------------------------------------------------
 
@@ -80,6 +83,7 @@ class HorovodGlobalState:
         up."""
         if self.initialized.is_set():
             return
+        self.async_error = None
         self.topo = topology or from_env()
         self._store = store
         self.cycle_time_ms = env_mod.get_float(
@@ -323,19 +327,39 @@ class HorovodGlobalState:
             self.timeline.op_end(response, entries)
         if status.pending:
             # Async device work dispatched: a finalizer-pool worker waits
-            # for readiness and fires the callbacks, so this loop moves
-            # straight on to the next negotiation cycle.
+            # for readiness, so this loop moves straight on to the next
+            # negotiation cycle.  In eager_complete mode (XLA plane:
+            # outputs are immutable jax futures) the callbacks fire NOW
+            # with unready arrays — downstream jax work chains on array
+            # readiness without a host round trip — and the finalizer
+            # degrades to a failure watchdog (sticky error surfaced on the
+            # next enqueue, the NCCL async-watchdog design).
             if self._finalizer_pool is None:
                 from .thread_pool import ThreadPool
 
                 self._finalizer_pool = ThreadPool(
                     env_mod.get_int("HOROVOD_NUM_FINALIZER_THREADS", 1),
                     name="horovod-finalizer")
-            self._finalizer_pool.execute(
-                lambda ents=entries: self._finalize_entries(ents))
+            if status.eager_complete:
+                for e in entries:
+                    self._fire_callback(e, Status.OK())
+                self._finalizer_pool.execute(
+                    lambda ents=entries: self._watch_entries(ents))
+            else:
+                self._finalizer_pool.execute(
+                    lambda ents=entries: self._finalize_entries(ents))
             return
         for e in entries:
             e.callback(status, e)
+
+    @staticmethod
+    def _fire_callback(e, status) -> None:
+        try:
+            e.callback(status, e)
+        except Exception:  # noqa: BLE001 — a raising callback must not
+            # kill the dispatching thread (later collectives would strand
+            # on unfired callbacks)
+            log.error("callback for %r raised", e.tensor_name, exc_info=True)
 
     @staticmethod
     def _finalize_entries(entries) -> None:
@@ -348,13 +372,23 @@ class HorovodGlobalState:
         except Exception as e:  # noqa: BLE001
             status = Status.error(f"XLA collective failed: {e}")
         for e in entries:
-            try:
-                e.callback(status, e)
-            except Exception:  # noqa: BLE001 — a raising callback must
-                # not kill the finalizer worker (later collectives would
-                # strand on unfired callbacks)
-                log.error("finalizer callback for %r raised",
-                          e.tensor_name, exc_info=True)
+            HorovodGlobalState._fire_callback(e, status)
+
+    def _watch_entries(self, entries) -> None:
+        """Failure watchdog for eager-complete dispatches: callbacks
+        already fired with unready arrays; here we only wait for the
+        device and convert an async failure into a sticky error that the
+        next enqueue raises (elastic's retry loop picks it up exactly
+        like a synchronous collective failure)."""
+        try:
+            import jax
+
+            jax.block_until_ready(
+                [e.output for e in entries if e.output is not None])
+        except Exception as e:  # noqa: BLE001
+            names = ", ".join(en.tensor_name for en in entries[:3])
+            log.error("async XLA collective failed (%s...): %s", names, e)
+            self.async_error = f"async XLA collective failed: {e}"
 
     def _fail_all_pending(self, msg: str) -> None:
         # Close first: an add racing the drain must fail fast, not strand.
@@ -393,6 +427,8 @@ class HorovodGlobalState:
         if not self.initialized.is_set() or self.topo is None:
             raise HorovodInternalError(
                 "horovod_tpu has not been initialized; call hvd.init() first.")
+        if self.async_error is not None:
+            raise HorovodInternalError(self.async_error)
         if self.init_error is not None:
             raise HorovodInternalError(f"initialization failed: {self.init_error}")
         if self.shutdown_complete.is_set() or \

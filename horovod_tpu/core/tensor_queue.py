@@ -35,6 +35,13 @@ class Status:
     # signals completion (reference IN_PROGRESS + finalizer-thread design,
     # ``gpu_operations.h:98-127``).
     pending: bool = False
+    # True when outputs are immutable device futures (jax arrays): callbacks
+    # fire IMMEDIATELY with the unready arrays — downstream jax work chains
+    # on array readiness with no host wait — while a finalizer watchdog
+    # still block_until_ready()s for failure detection, surfacing errors on
+    # the next enqueue like the reference's NCCL async-error watchdog
+    # (``nccl_operations.cc:96-109``).
+    eager_complete: bool = False
 
     @staticmethod
     def OK() -> "Status":
@@ -43,6 +50,10 @@ class Status:
     @staticmethod
     def in_progress() -> "Status":
         return Status(True, "", pending=True)
+
+    @staticmethod
+    def dispatched() -> "Status":
+        return Status(True, "", pending=True, eager_complete=True)
 
     @staticmethod
     def error(msg: str) -> "Status":

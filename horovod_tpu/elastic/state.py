@@ -186,7 +186,97 @@ def _reset_and_reinit() -> None:
     from .rendezvous_client import refresh_topology_from_rendezvous
 
     topo = refresh_topology_from_rendezvous()
+    _reinit_xla_plane(topo)
     core_state.global_state().initialize(topology=topo)
+
+
+def _reinit_xla_plane(topo) -> None:
+    """Re-establish the XLA data plane for the NEW world (the part SURVEY
+    §7.4 flags as hard; reference analog: the Gloo elastic re-rendezvous
+    branch, ``gloo_context.cc:154-189``).
+
+    jax refuses ``distributed.initialize`` once backends exist, so the
+    sequence is: shut the old multi-controller runtime down, drop the
+    backend singletons (old-world device arrays become invalid — elastic
+    state lives in host numpy snapshots, so nothing live depends on them),
+    then bring the runtime up against a coordinator for THIS epoch.  The
+    new rank 0 binds a free port and publishes ``host:port`` to the
+    rendezvous store under an epoch-scoped key; everyone else polls it.
+    """
+    import os
+
+    from ..backend import xla as xla_backend
+    from ..common import env as env_mod
+
+    plane = xla_backend.data_plane_requested()
+    if plane not in ("xla", "auto"):
+        return
+    xla_backend.context().reset()
+    import jax
+
+    # Tear the OLD world's runtime down whenever one exists — including a
+    # shrink to size 1, where a leftover distributed client would keep
+    # heartbeating a coordinator that may live on the dead host.
+    if jax.distributed.is_initialized():
+        from jax._src import xla_bridge
+
+        jax.distributed.shutdown()
+        jax.clear_caches()
+        xla_bridge._clear_backends()
+    elif plane != "xla":
+        return  # auto mode never had a device plane; keep TCP
+
+    if topo.size <= 1:
+        return  # single survivor: local mesh only, no distributed runtime
+
+    # Epoch-scoped coordinator handoff (the old coordinator host may be
+    # the one that died).
+    coord = negotiate_jax_coordinator(topo)
+    os.environ[env_mod.HOROVOD_JAX_COORDINATOR] = coord
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=topo.size,
+                               process_id=topo.rank)
+
+
+def negotiate_jax_coordinator(topo) -> str:
+    """Publish/fetch the jax.distributed coordinator for THIS elastic
+    epoch through the rendezvous store: the new rank 0 binds a free port
+    and publishes ``host:port``; everyone else polls.  Epoch-scoped keys
+    keep a stale coordinator from a previous incarnation out of play."""
+    from ..common import env as env_mod
+    from ..common.exceptions import HorovodInternalError
+    from ..transport.store import HTTPStoreClient
+    from ..transport.tcp import candidate_advertise_addrs
+
+    addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        raise HorovodInternalError(
+            "jax coordinator negotiation requires the rendezvous store")
+    store = HTTPStoreClient(addr, port)
+    epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+    scope = f"jaxcoord.{epoch}"
+    if topo.rank == 0:
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("", 0))
+        coord_port = s.getsockname()[1]
+        s.close()  # jax's coordinator service rebinds it immediately
+        coord = f"{candidate_advertise_addrs()[0]}:{coord_port}"
+        store.set(scope, "addr", coord.encode())
+        return coord
+    import time as _time
+
+    deadline = _time.monotonic() + 120
+    while True:
+        raw = store.get(scope, "addr")
+        if raw:
+            return raw.decode()
+        if _time.monotonic() > deadline:
+            raise HorovodInternalError(
+                "no jax coordinator published for epoch %d" % epoch)
+        _time.sleep(0.25)
 
 
 def _teardown() -> None:

@@ -57,6 +57,7 @@ from .functions import (  # noqa: F401
     broadcast_parameters,
 )
 from .optimizer import (  # noqa: F401
+    DistributedAdasumOptimizer,
     DistributedOptimizer,
     distributed_value_and_grad,
 )

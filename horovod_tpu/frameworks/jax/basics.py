@@ -36,6 +36,13 @@ def _maybe_init_jax_distributed(topology: Optional[ProcessTopology]) -> None:
     if jax.distributed.is_initialized():
         return
     coord = env_mod.get_str(env_mod.HOROVOD_JAX_COORDINATOR)
+    if not coord and env_mod.get_bool(env_mod.HOROVOD_ELASTIC):
+        # Elastic jobs negotiate the coordinator through the rendezvous
+        # store (epoch-scoped — the launcher cannot pin one for the whole
+        # job because the coordinator host itself may be replaced).
+        from ...elastic.state import negotiate_jax_coordinator
+
+        coord = negotiate_jax_coordinator(topo)
     if not coord:
         if plane == "xla":
             # An explicit request must fail loudly, not degrade silently.

@@ -166,6 +166,16 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
     if optax is None:  # pragma: no cover
         raise ImportError("optax is required for DistributedOptimizer")
     op_name = op or ops.Average
+    if op_name == ops.Adasum:
+        # Reference factory parity (``tensorflow/__init__.py:465-561``):
+        # op=Adasum selects the delta-space optimizer, not gradient-space
+        # adasum reduction.
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "backward_passes_per_step > 1 is not supported with "
+                "op=Adasum (the delta-space optimizer communicates whole "
+                "optimizer steps; wrap tx in optax.MultiSteps instead)")
+        return DistributedAdasumOptimizer(tx, compression=compression)
     n_accum = backward_passes_per_step
 
     # Every pure piece of the update runs under jit (compiled lazily, once
@@ -228,6 +238,46 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
         updates, inner = _jitted("update", tx.update)(
             grads, state.inner_state, params)
         return updates, DistributedState(inner, new_acc, count)
+
+    return optax.GradientTransformation(init, update)
+
+
+def DistributedAdasumOptimizer(tx, compression=Compression.none):
+    """Adasum in DELTA space (reference ``_DistributedAdasumOptimizer``,
+    ``tensorflow/__init__.py:368-462`` / ``torch/optimizer.py:210-379``):
+    instead of combining *gradients*, each rank computes its local
+    optimizer step and the Adasum operator combines the resulting
+    parameter *deltas* — ``a' = (1−a·b/2‖a‖²)·a + (1−a·b/2‖b‖²)·b`` per
+    tensor — which is the formulation Microsoft shipped for convergence
+    (scale-insensitive merging of whole steps, not raw gradients).
+
+    optax makes this natural: ``tx.update`` already returns additive
+    deltas, so the wrapper is "inner update locally, Adasum-allreduce the
+    updates".  Per-leaf wire tensors (the operator's dot/norm math is
+    per-tensor; fusing would change it).
+    """
+    if optax is None:  # pragma: no cover
+        raise ImportError("optax is required for DistributedAdasumOptimizer")
+
+    _jits: dict = {}
+
+    def _jitted(fn):
+        import jax
+
+        if "u" not in _jits:
+            _jits["u"] = jax.jit(fn)
+        return _jits["u"]
+
+    def init(params):
+        return tx.init(params)
+
+    def update(grads, state, params=None):
+        updates, inner = _jitted(tx.update)(grads, state, params)
+        if ops.initialized():
+            updates = _allreduce_tree_per_leaf(
+                updates, ops.Adasum, compression, 1.0, 1.0,
+                name_prefix="adasum.delta")
+        return updates, inner
 
     return optax.GradientTransformation(init, update)
 

@@ -139,23 +139,9 @@ def _graph_collective(kind: str, tensor, name: Optional[str], eager_fn,
 # ---------------------------------------------------------------------------
 
 
-def allreduce(tensor, average: Optional[bool] = None,
-              name: Optional[str] = None, op: Optional[str] = None,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
-    """Eager allreduce of a tf.Tensor (or IndexedSlices, which take the
-    reference's allgather path, ``tensorflow/__init__.py:92-108``)."""
+def _allreduce_raw(tensor, average, name, op, prescale_factor,
+                   postscale_factor):
     tf = _tf()
-    if isinstance(tensor, tf.IndexedSlices):
-        if op == Adasum:
-            raise NotImplementedError(
-                "IndexedSlices + Adasum is unsupported (reference parity)")
-        # allgather values and indices; average divides by size
-        values = allgather(tensor.values, name=(name or "") + ".values" if name else None)
-        indices = allgather(tensor.indices, name=(name or "") + ".indices" if name else None)
-        if average or (average is None and op in (None, Average)):
-            values = values / size()
-        return tf.IndexedSlices(values, indices,
-                                dense_shape=tensor.dense_shape)
     if _is_symbolic(tensor):
         return _graph_collective(
             "allreduce", tensor, name,
@@ -170,7 +156,55 @@ def allreduce(tensor, average: Optional[bool] = None,
     return tf.convert_to_tensor(np.asarray(out))
 
 
-def allgather(tensor, name: Optional[str] = None):
+def _grad_name(name: Optional[str], suffix: str) -> Optional[str]:
+    """Wire name for a backward collective: distinct from the forward's
+    (both run every step; a shared name would collide in negotiation)."""
+    return f"{name}.{suffix}" if name else None
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[str] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Allreduce of a tf.Tensor (or IndexedSlices, which take the
+    reference's allgather path, ``tensorflow/__init__.py:92-108``).
+
+    Differentiable: the gradient is an allreduce with the same
+    op/prescale/postscale, matching the reference's registered gradient
+    (``tensorflow/mpi_ops.py:116-133``) so ``tf.GradientTape`` works
+    *through* the collective (e.g. allreduce-in-loss)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        if op == Adasum:
+            raise NotImplementedError(
+                "IndexedSlices + Adasum is unsupported (reference parity)")
+        # allgather values and indices; average divides by size
+        values = allgather(tensor.values, name=(name or "") + ".values" if name else None)
+        indices = allgather(tensor.indices, name=(name or "") + ".indices" if name else None)
+        if average or (average is None and op in (None, Average)):
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    if not tf.as_dtype(tensor.dtype).is_floating:
+        return _allreduce_raw(tensor, average, name, op,
+                              prescale_factor, postscale_factor)
+
+    @tf.custom_gradient
+    def fwd(t):
+        out = _allreduce_raw(t, average, name, op,
+                             prescale_factor, postscale_factor)
+
+        def grad(dy):
+            return allreduce(dy, average=average,
+                             name=_grad_name(name, "grad"), op=op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor)
+
+        return out, grad
+
+    return fwd(tf.convert_to_tensor(tensor))
+
+
+def _allgather_raw(tensor, name):
     tf = _tf()
     if _is_symbolic(tensor):
         return _graph_collective(
@@ -181,7 +215,34 @@ def allgather(tensor, name: Optional[str] = None):
     return tf.convert_to_tensor(np.asarray(out))
 
 
-def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate each rank's tensor along dim 0.
+
+    Differentiable: grad = sum-allreduce of the upstream gradient, then
+    this rank's slice (reference ``tensorflow/mpi_ops.py:156-181``)."""
+    tf = _tf()
+    if not tf.as_dtype(tensor.dtype).is_floating:
+        return _allgather_raw(tensor, name)
+
+    @tf.custom_gradient
+    def fwd(t):
+        out = _allgather_raw(t, name)
+
+        def grad(dy):
+            summed = allreduce(dy, op=Sum, name=_grad_name(name, "grad"))
+            dim0 = tf.reshape(tf.shape(t)[0], [1])
+            sizes = tf.reshape(
+                _allgather_raw(dim0, _grad_name(name, "grad.sizes")),
+                [size()])
+            offset = tf.reduce_sum(sizes[:rank()])
+            return summed[offset:offset + sizes[rank()]]
+
+        return out, grad
+
+    return fwd(tf.convert_to_tensor(tensor))
+
+
+def _broadcast_raw(tensor, root_rank, name):
     tf = _tf()
     if _is_symbolic(tensor):
         return _graph_collective(
@@ -192,8 +253,31 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     return tf.convert_to_tensor(np.asarray(out))
 
 
-def alltoall(tensor, splits: Optional[List[int]] = None,
-             name: Optional[str] = None):
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    """Broadcast root's tensor to every rank.
+
+    Differentiable: grad = sum-allreduce on the root, zeros elsewhere
+    (reference ``tensorflow/mpi_ops.py:203-218``)."""
+    tf = _tf()
+    if not tf.as_dtype(tensor.dtype).is_floating:
+        return _broadcast_raw(tensor, root_rank, name)
+
+    @tf.custom_gradient
+    def fwd(t):
+        out = _broadcast_raw(t, root_rank, name)
+
+        def grad(dy):
+            reduced = allreduce(dy, op=Sum, name=_grad_name(name, "grad"))
+            if rank() != root_rank:
+                return reduced * 0
+            return reduced
+
+        return out, grad
+
+    return fwd(tf.convert_to_tensor(tensor))
+
+
+def _alltoall_raw(tensor, splits, name):
     tf = _tf()
     if _is_symbolic(tensor):
         return _graph_collective(
@@ -202,6 +286,60 @@ def alltoall(tensor, splits: Optional[List[int]] = None,
             out_shape=tf.TensorShape([None]).concatenate(tensor.shape[1:]))
     out = _core_ops.alltoall(_to_numpy(tensor), splits=splits, name=name)
     return tf.convert_to_tensor(np.asarray(out))
+
+
+def alltoall(tensor, splits: Optional[List[int]] = None,
+             name: Optional[str] = None):
+    """Scatter row-blocks to every rank, gather theirs.
+
+    Differentiable: grad = alltoall back along the reversed split matrix
+    (reference ``tensorflow/mpi_ops.py:253-268``)."""
+    tf = _tf()
+    if not tf.as_dtype(tensor.dtype).is_floating:
+        return _alltoall_raw(tensor, splits, name)
+
+    # Wire names must be fixed at TRACE time (graphs re-execute; per-call
+    # auto names would desync ranks that trace different step counts).
+    if name:
+        gname, sname = f"{name}.grad", f"{name}.grad.splits"
+    elif _is_symbolic(tensor):
+        base = _unnamed_wire_name(tf)  # per-graph counter, rank-consistent
+        gname, sname = f"tf.a2a.{base}.grad", f"tf.a2a.{base}.grad.splits"
+    else:
+        # Eager + unnamed: let the core auto-name per call — consistent
+        # across ranks by identical call order, like every eager op.
+        gname = sname = None
+
+    @tf.custom_gradient
+    def fwd(t):
+        out = _alltoall_raw(t, splits, name)
+
+        def _grad_np(dyv, tv):
+            # Runs at EXECUTION time on concrete values (an alltoall at
+            # trace time would block negotiation whenever one rank
+            # retraces and its peers do not).  Each rank's recv splits =
+            # column of the send-split matrix; one tiny alltoall of the
+            # send row computes it (reference ``mpi_ops.py:253-268``).
+            n0 = int(np.asarray(tv).shape[0])
+            send = list(splits) if splits is not None \
+                else [n0 // size()] * size()
+            recv = _core_ops.alltoall(np.asarray(send, np.int32),
+                                      splits=[1] * size(), name=sname)
+            out_np = _core_ops.alltoall(
+                np.asarray(dyv), splits=[int(v) for v in np.asarray(recv)],
+                name=gname)
+            return tf.convert_to_tensor(np.asarray(out_np))
+
+        def grad(dy):
+            if _is_symbolic(dy):
+                g = tf.py_function(_grad_np, [dy, t], Tout=dy.dtype)
+                g.set_shape(t.shape)
+                return g
+            return _grad_np(dy, t)
+
+        return out, grad
+
+    return fwd(tf.convert_to_tensor(tensor))
 
 
 # ---------------------------------------------------------------------------
@@ -431,10 +569,55 @@ __all__ = [
 ]
 
 
+_sync_bn_class = None
+
+
+def _build_sync_batch_norm():
+    """``SyncBatchNormalization``: batch-norm whose batch statistics are
+    averaged across every rank (reference
+    ``tensorflow/sync_batch_norm.py:32-55``): compute local moments, then
+    allreduce the stacked [mean, mean-of-square] and recover the global
+    variance as E[X²] − E[X]².  Built lazily so importing this module does
+    not require tensorflow."""
+    global _sync_bn_class
+    if _sync_bn_class is not None:
+        return _sync_bn_class
+    tf = _tf()
+
+    class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+        # No default layer name: Keras 3 rejects duplicate explicit names,
+        # and models routinely hold many of these — auto-naming keeps each
+        # instance's wire name (f"sync_bn.{self.name}") unique too.
+        def __init__(self, **kwargs):
+            if kwargs.pop("fused", False):
+                raise ValueError(
+                    "SyncBatchNormalization does not support fused=True.")
+            super().__init__(**kwargs)
+
+        def _moments(self, inputs, mask=None):
+            mean, variance = super()._moments(inputs, mask)
+            if size() <= 1:
+                return mean, variance
+            # Var[X] = E[X²] − E[X]²: mean-of-square allreduces linearly,
+            # variance itself would not.
+            mean_sq = variance + tf.math.square(mean)
+            stacked = tf.stack([mean, mean_sq])
+            reduced = allreduce(stacked, op=Sum,
+                                name=f"sync_bn.{self.name}") / size()
+            g_mean, g_mean_sq = tf.unstack(reduced)
+            return g_mean, g_mean_sq - tf.math.square(g_mean)
+
+    _sync_bn_class = SyncBatchNormalization
+    return _sync_bn_class
+
+
 def __getattr__(name):
-    # Lazy submodule (PEP 562): hvd.elastic.TensorFlowKerasState.
+    # Lazy attributes (PEP 562): hvd.elastic.* and hvd.SyncBatchNormalization
+    # work without importing tensorflow at package-import time.
     if name == "elastic":
         import importlib
 
         return importlib.import_module(".elastic", __name__)
+    if name == "SyncBatchNormalization":
+        return _build_sync_batch_norm()
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

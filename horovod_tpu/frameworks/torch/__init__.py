@@ -388,21 +388,100 @@ class _DistributedOptimizer:
         return self._opt.zero_grad(*args, **kwargs)
 
 
+class _DistributedAdasumOptimizer:
+    """Adasum in DELTA space (reference ``torch/optimizer.py:210-379``):
+    ``step()`` runs the inner optimizer LOCALLY, then the parameter deltas
+    (w_new − w_old) are combined across ranks with the Adasum operator and
+    applied on top of the old weights — merging whole optimizer steps
+    scale-insensitively instead of averaging raw gradients.
+
+    Simplification vs the reference: the reference stages per-parameter
+    inner steps from WFBP hooks to overlap comm with backprop; this compat
+    surface steps once then reduces (same math — element-wise optimizers
+    factor per parameter — with less overlap, acceptable for the
+    CPU-staging compat path)."""
+
+    def __init__(self, optimizer, named_parameters=None, compression=None):
+        self._opt = optimizer
+        self._compression = compression or Compression.none
+        if named_parameters is not None:
+            self._names = {id(p): n for n, p in named_parameters}
+        else:
+            self._names = {}
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def _name(self, gi: int, pi: int, p) -> str:
+        return self._names.get(id(p), f"group{gi}.param{pi}")
+
+    def synchronize(self) -> None:
+        raise HorovodInternalError(
+            "Skipping synchronization is not supported when using Adasum "
+            "optimizer (reference optimizer.py:346)")
+
+    def step(self, closure=None):
+        torch = _torch()
+        stash = []
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if p.grad is not None:
+                    stash.append((p, p.detach().clone()))
+        loss = self._opt.step(closure)
+
+        handles = []
+        for gi, group in enumerate(self._opt.param_groups):
+            for pi, p in enumerate(group["params"]):
+                if p.grad is None:
+                    continue
+                old = next(o for q, o in stash if q is p)
+                delta = p.detach() - old
+                comp, ctx = self._compression.compress(delta)
+                h = allreduce_async(comp, op=Adasum,
+                                    name=f"adasum.delta.{self._name(gi, pi, p)}")
+                handles.append((p, old, h, ctx))
+        for p, old, h, ctx in handles:
+            out = synchronize(h)
+            out = self._compression.decompress(out, ctx)
+            with torch.no_grad():
+                p.data.copy_(old + out.reshape(p.shape).to(p.dtype))
+        return loss
+
+    def zero_grad(self, *args, **kwargs):
+        return self._opt.zero_grad(*args, **kwargs)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None, compression=None,
                          backward_passes_per_step: int = 1,
                          op: str = Average):
+    if op == Adasum:
+        # Reference factory parity (``torch/optimizer.py:381-445``):
+        # op=Adasum selects the delta-space optimizer.
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "backward_passes_per_step > 1 is not supported with "
+                "op=Adasum (the delta-space optimizer communicates whole "
+                "optimizer steps; accumulate before calling step())")
+        return _DistributedAdasumOptimizer(
+            optimizer, named_parameters=named_parameters,
+            compression=compression)
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op)
 
 
 def __getattr__(name):
-    # Lazy submodule (PEP 562): ``hvd.elastic.TorchState`` works without
-    # importing torch for numpy-only users of this surface.
+    # Lazy attributes (PEP 562): ``hvd.elastic.TorchState`` /
+    # ``hvd.SyncBatchNorm`` work without importing torch for numpy-only
+    # users of this surface.
     if name == "elastic":
         import importlib
 
         return importlib.import_module(".elastic", __name__)
+    if name == "SyncBatchNorm":
+        from .sync_batch_norm import SyncBatchNorm
+
+        return SyncBatchNorm
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1,0 +1,123 @@
+"""SyncBatchNorm for the PyTorch surface.
+
+Role of the reference's hand-written autograd version
+(``torch/sync_batch_norm.py:39-199``): batch normalization whose batch
+statistics come from the GLOBAL batch — every rank's sum / sum-of-squares /
+count allreduced in the forward, and the two gradient reductions of the BN
+backward allreduced again — so tiny per-rank batches normalize as if they
+were one big batch.
+
+Differences from the reference, on purpose: statistics ride our eager
+allreduce (XLA/TCP data plane) instead of NCCL, and CPU tensors are
+supported (the reference requires CUDA inputs because it reuses torch's GPU
+kernels; this implementation is written directly against the BN math).
+Parameter gradients (weight/bias) stay LOCAL sums — ``DistributedOptimizer``
+averages them with every other parameter gradient.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+from torch.autograd.function import Function
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from . import Sum, allreduce, size
+
+
+def _channel_view(t: torch.Tensor) -> torch.Tensor:
+    """[N, C, *] → [C, N*prod(*)] so per-channel reductions are dim-1."""
+    return t.transpose(0, 1).reshape(t.shape[1], -1)
+
+
+class _SyncBatchNormFn(Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, eps):
+        flat = _channel_view(x)
+        local_count = flat.shape[1]
+        stats = torch.stack([
+            flat.sum(dim=1),
+            (flat * flat).sum(dim=1),
+            torch.full((flat.shape[0],), float(local_count), dtype=flat.dtype),
+        ])
+        if size() > 1:
+            stats = allreduce(stats, op=Sum, name="sync_bn.fwd.stats")
+        total_sum, total_sqsum, total_count = stats
+        count = total_count[0].item()
+        mean = total_sum / count
+        var = total_sqsum / count - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        shape = [1, -1] + [1] * (x.dim() - 2)
+        xhat = (x - mean.view(shape)) * invstd.view(shape)
+        out = xhat * weight.view(shape) + bias.view(shape)
+
+        ctx.save_for_backward(xhat, weight, invstd)
+        ctx.count = count
+        return out, mean, var, torch.tensor(count)
+
+    @staticmethod
+    def backward(ctx, dy, _dmean, _dvar, _dcount):
+        xhat, weight, invstd = ctx.saved_tensors
+        shape = [1, -1] + [1] * (dy.dim() - 2)
+
+        dy_flat = _channel_view(dy)
+        xhat_flat = _channel_view(xhat)
+        # Local per-channel reductions; dx needs the GLOBAL versions.
+        g_dy = dy_flat.sum(dim=1)
+        g_dy_xhat = (dy_flat * xhat_flat).sum(dim=1)
+        if size() > 1:
+            reduced = allreduce(torch.stack([g_dy, g_dy_xhat]), op=Sum,
+                                name="sync_bn.bwd.stats")
+            sum_dy, sum_dy_xhat = reduced
+        else:
+            sum_dy, sum_dy_xhat = g_dy, g_dy_xhat
+
+        n = ctx.count
+        dx = (weight * invstd).view(shape) * (
+            dy - (sum_dy.view(shape) + xhat * sum_dy_xhat.view(shape)) / n)
+        # weight/bias grads are LOCAL (DistributedOptimizer averages them)
+        return dx, g_dy_xhat, g_dy, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in ``nn.BatchNorm{1,2,3}d`` replacement with cross-rank batch
+    statistics (reference ``torch/sync_batch_norm.py:39-97``)."""
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D input)")
+
+    def forward(self, input: torch.Tensor) -> torch.Tensor:
+        self._check_input_dim(input)
+
+        if self.training and self.track_running_stats and \
+                self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)
+
+        use_batch_stats = self.training or not self.track_running_stats
+        if not use_batch_stats:
+            return F.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, False, 0.0, self.eps)
+
+        weight = self.weight if self.weight is not None else \
+            torch.ones(input.shape[1], dtype=input.dtype)
+        bias = self.bias if self.bias is not None else \
+            torch.zeros(input.shape[1], dtype=input.dtype)
+        out, mean, var, count = _SyncBatchNormFn.apply(
+            input, weight, bias, self.eps)
+
+        if self.training and self.track_running_stats:
+            m = self.momentum if self.momentum is not None else \
+                1.0 / float(self.num_batches_tracked)
+            n = float(count)  # exact global element count per channel
+            unbiased = var.detach() * n / max(n - 1.0, 1.0)
+            with torch.no_grad():
+                self.running_mean.mul_(1 - m).add_(mean.detach(), alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+        return out
+
+
+__all__ = ["SyncBatchNorm"]

@@ -19,9 +19,11 @@ from . import run as spark_run
 from .common import LocalStore, Store, extract_arrays, shard
 
 
-def _train_task(model_blob: bytes, opt_factory, loss_fn, x, y,
+def _train_task(model_blob: bytes, opt_factory, loss_fn, data,
                 batch_size: int, epochs: int,
                 store: Optional[Store], ckpt_path: str):
+    import json
+
     import torch
 
     import horovod_tpu.torch as hvd
@@ -37,17 +39,29 @@ def _train_task(model_blob: bytes, opt_factory, loss_fn, x, y,
         hvd.broadcast_parameters(model.state_dict(), root_rank=0)
         hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
-        sx, sy = shard(np.asarray(x), np.asarray(y), hvd.rank(), hvd.size())
+        val = None
+        if data[0] == "store":
+            from .common import read_shards
+
+            manifest = data[1]
+            sx, sy = read_shards(store, manifest, hvd.rank(), hvd.size())
+            if manifest.get("val_rows", 0) > 0:
+                val = read_shards(store, manifest, hvd.rank(), hvd.size(),
+                                  split="val")
+        else:
+            _, x, y = data
+            sx, sy = shard(np.asarray(x), np.asarray(y),
+                           hvd.rank(), hvd.size())
         if len(sx) == 0:
             raise ValueError(
                 f"rank {hvd.rank()}'s data shard is empty: the dataset "
-                f"({len(x)} rows) must have at least num_proc={hvd.size()} "
-                "rows")
+                f"must have at least num_proc={hvd.size()} rows")
         tx = torch.as_tensor(sx, dtype=torch.float32)
         ty = torch.as_tensor(sy)
         n = len(tx)
         losses = []
-        for _ in range(epochs):
+        history = []
+        for epoch in range(epochs):
             perm = torch.randperm(n)
             loss = None
             for lo in range(0, n, batch_size):
@@ -57,6 +71,18 @@ def _train_task(model_blob: bytes, opt_factory, loss_fn, x, y,
                 loss.backward()
                 optimizer.step()
             losses.append(float(loss))
+            logs = {"loss": float(loss)}
+            if val is not None:
+                with torch.no_grad():
+                    vx = torch.as_tensor(val[0], dtype=torch.float32)
+                    vy = torch.as_tensor(val[1])
+                    logs["val_loss"] = float(loss_fn(model(vx), vy))
+            history.append(logs)
+            if hvd.rank() == 0 and store is not None:
+                # Per-epoch metric log through the Store (reference
+                # ``spark/torch/remote.py`` epoch-log role).
+                store.save_bytes(f"logs/epoch-{epoch:04d}.json",
+                                 json.dumps(logs).encode())
 
         state = {k: v.cpu() for k, v in model.state_dict().items()} \
             if hvd.rank() == 0 else None
@@ -64,7 +90,7 @@ def _train_task(model_blob: bytes, opt_factory, loss_fn, x, y,
             buf = io.BytesIO()
             torch.save(state, buf)
             store.save_bytes(ckpt_path, buf.getvalue())
-        return {"state_dict": state, "losses": losses}
+        return {"state_dict": state, "losses": losses, "history": history}
     finally:
         hvd.shutdown()
 
@@ -82,7 +108,8 @@ class TorchEstimator:
                  batch_size: int = 32, epochs: int = 1,
                  num_proc: Optional[int] = None,
                  store: Optional[Store] = None,
-                 checkpoint_path: str = "torch_checkpoint.pt", sc=None):
+                 checkpoint_path: str = "torch_checkpoint.pt",
+                 validation: float = 0.0, sc=None):
         self.model = model
         self.optimizer_factory = optimizer_factory
         self.loss = loss
@@ -93,22 +120,33 @@ class TorchEstimator:
         self.num_proc = num_proc
         self.store = store
         self.checkpoint_path = checkpoint_path
+        self.validation = validation
         self.sc = sc
 
     def fit(self, df) -> "TorchModel":
         from . import _default_spark_context
 
         sc = self.sc or _default_spark_context()
-        x, y = extract_arrays(df, self.feature_cols, self.label_cols)
-        n_proc = self.num_proc or int(
-            getattr(sc, "defaultParallelism", 0) or 0)
-        if n_proc and len(x) < n_proc:
-            raise ValueError(f"dataset has {len(x)} rows < "
-                             f"num_proc={n_proc}")
+        if hasattr(df, "rdd") and self.store is not None:
+            # Store-partitioned plane (see keras.py fit; VERDICT r2 #4).
+            from .common import prepare_dataset
+
+            manifest = prepare_dataset(
+                df, self.store, self.feature_cols, self.label_cols,
+                validation=self.validation)
+            data = ("store", manifest)
+        else:
+            x, y = extract_arrays(df, self.feature_cols, self.label_cols)
+            n_proc = self.num_proc or int(
+                getattr(sc, "defaultParallelism", 0) or 0)
+            if n_proc and len(x) < n_proc:
+                raise ValueError(f"dataset has {len(x)} rows < "
+                                 f"num_proc={n_proc}")
+            data = ("inline", x, y)
         model_blob = dumps(self.model)
         results = spark_run(
             _train_task,
-            args=(model_blob, self.optimizer_factory, self.loss, x, y,
+            args=(model_blob, self.optimizer_factory, self.loss, data,
                   self.batch_size, self.epochs, self.store,
                   self.checkpoint_path),
             num_proc=self.num_proc, sc=sc)

@@ -6,8 +6,8 @@ from .frameworks.torch import __all__  # noqa: F401
 
 
 def __getattr__(name):
-    if name == "elastic":
-        from .frameworks.torch import elastic
+    if name in ("elastic", "SyncBatchNorm"):
+        from .frameworks import torch as _impl
 
-        return elastic
+        return getattr(_impl, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -68,3 +68,18 @@ class FakeSparkContext:
         for i, item in enumerate(data):
             chunks[i % numSlices].append(item)
         return FakeRDD(chunks)
+
+
+class FakeDataFrame:
+    """DataFrame stand-in for the Store-partitioned plane: rows (dicts)
+    pre-chunked into partitions; ``.rdd.mapPartitionsWithIndex`` runs each
+    partition in its own spawned subprocess like FakeRDD."""
+
+    def __init__(self, rows: List[dict], num_partitions: int = 2):
+        self._rows = list(rows)
+        self._n = num_partitions
+
+    @property
+    def rdd(self) -> FakeRDD:
+        chunks = [self._rows[i::self._n] for i in range(self._n)]
+        return FakeRDD(chunks)

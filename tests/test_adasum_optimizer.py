@@ -1,0 +1,148 @@
+"""Adasum delta-space optimizers (jax/optax + torch) vs the closed-form
+operator.
+
+Reference math (``adasum.h:194-450``): for two contributions a, b,
+
+    a' = (1 − a·b / (2‖a‖²))·a + (1 − a·b / (2‖b‖²))·b
+
+The delta optimizers apply this to parameter DELTAS (local optimizer step
+results), not gradients (reference ``tensorflow/__init__.py:368-462``,
+``torch/optimizer.py:210-379``).
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from tests.helpers import run_distributed
+
+
+def adasum_combine(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    dot = float(np.dot(a.ravel(), b.ravel()))
+    na = float(np.dot(a.ravel(), a.ravel()))
+    nb = float(np.dot(b.ravel(), b.ravel()))
+    ca = 1.0 - dot / (2 * na) if na else 1.0
+    cb = 1.0 - dot / (2 * nb) if nb else 1.0
+    return ca * a + cb * b
+
+
+def test_jax_adasum_delta_two_ranks():
+    """SGD deltas are −lr·g per rank; the merged update must equal the
+    closed-form Adasum combine of the two deltas."""
+    body = textwrap.dedent("""
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.frameworks.jax.optimizer import DistributedOptimizer
+
+    lr = 0.5
+    tx = optax.sgd(lr)
+    dopt = DistributedOptimizer(tx, op="adasum")
+    params = {"w": jnp.array([1.0, 2.0, 3.0])}
+    st = dopt.init(params)
+    grads = {"w": jnp.array([1.0, 0.5, -1.0]) * (rank + 1)}
+    updates, st = dopt.update(grads, st, params)
+
+    # expected: adasum_combine(-lr*g0, -lr*g1)
+    g0 = np.array([1.0, 0.5, -1.0]); g1 = 2 * g0
+    a, b = -lr * g0, -lr * g1
+    dot = a @ b
+    exp = (1 - dot/(2*(a@a)))*a + (1 - dot/(2*(b@b)))*b
+    got = np.asarray(updates["w"])
+    assert np.allclose(got, exp, atol=1e-5), (got, exp)
+    print("JAX_ADASUM_OK", rank)
+    """)
+    for out in run_distributed(2, body, timeout=180):
+        assert "JAX_ADASUM_OK" in out
+
+
+def test_torch_adasum_delta_two_ranks():
+    pytest.importorskip("torch")
+    body = textwrap.dedent("""
+    import torch
+    import horovod_tpu.torch as hvdt
+
+    lr = 0.5
+    w0 = torch.tensor([1.0, 2.0, 3.0])
+    p = torch.nn.Parameter(w0.clone())
+    opt = torch.optim.SGD([p], lr=lr)
+    dopt = hvdt.DistributedOptimizer(opt, op=hvdt.Adasum)
+
+    g = torch.tensor([1.0, 0.5, -1.0]) * (rank + 1)
+    p.grad = g.clone()
+    dopt.step()
+
+    g0 = np.array([1.0, 0.5, -1.0]); g1 = 2 * g0
+    a, b = -lr * g0, -lr * g1
+    dot = a @ b
+    exp_delta = (1 - dot/(2*(a@a)))*a + (1 - dot/(2*(b@b)))*b
+    exp = np.array([1.0, 2.0, 3.0]) + exp_delta
+    assert np.allclose(p.detach().numpy(), exp, atol=1e-5), \\
+        (p.detach().numpy(), exp)
+    print("TORCH_ADASUM_OK", rank)
+    """)
+    for out in run_distributed(2, body, timeout=180):
+        assert "TORCH_ADASUM_OK" in out
+
+
+def test_torch_adasum_momentum_delta():
+    """Momentum makes the local delta ≠ −lr·g; the operator must combine
+    the ACTUAL deltas (catches gradient-space implementations)."""
+    pytest.importorskip("torch")
+    body = textwrap.dedent("""
+    import torch
+    import horovod_tpu.torch as hvdt
+
+    lr, mom = 0.1, 0.9
+    p = torch.nn.Parameter(torch.tensor([2.0, -1.0]))
+    opt = torch.optim.SGD([p], lr=lr, momentum=mom)
+    dopt = hvdt.DistributedOptimizer(opt, op=hvdt.Adasum)
+
+    def ref_delta(g, buf):
+        buf = mom * buf + g
+        return -lr * buf, buf
+
+    g_mine = np.array([1.0, 1.0]) * (rank + 1)
+    bufs = [np.zeros(2), np.zeros(2)]
+    deltas = []
+    for r in range(2):
+        d, bufs[r] = ref_delta(np.array([1.0, 1.0]) * (r + 1), bufs[r])
+        deltas.append(d)
+    a, b = deltas
+    dot = a @ b
+    exp_delta = (1 - dot/(2*(a@a)))*a + (1 - dot/(2*(b@b)))*b
+
+    p.grad = torch.tensor(g_mine, dtype=torch.float32)
+    dopt.step()
+    exp = np.array([2.0, -1.0]) + exp_delta
+    assert np.allclose(p.detach().numpy(), exp, atol=1e-5), \\
+        (p.detach().numpy(), exp)
+    print("TORCH_ADASUM_MOM_OK", rank)
+    """)
+    for out in run_distributed(2, body, timeout=180):
+        assert "TORCH_ADASUM_MOM_OK" in out
+
+
+def test_adasum_identical_deltas_idempotent():
+    """Adasum of two identical contributions is their mean — so identical
+    ranks behave exactly like single-process training."""
+    body = textwrap.dedent("""
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.frameworks.jax.optimizer import DistributedAdasumOptimizer
+
+    tx = optax.sgd(0.25)
+    dopt = DistributedAdasumOptimizer(tx)
+    params = {"w": jnp.array([4.0, -2.0])}
+    st = dopt.init(params)
+    grads = {"w": jnp.array([1.0, 3.0])}
+    updates, st = dopt.update(grads, st, params)
+    # identical a == b: a' = (1-1/2)a + (1-1/2)b = a
+    assert np.allclose(np.asarray(updates["w"]), -0.25 * np.array([1.0, 3.0]),
+                       atol=1e-6)
+    print("ADASUM_IDEM_OK", rank)
+    """)
+    for out in run_distributed(2, body, timeout=180):
+        assert "ADASUM_IDEM_OK" in out

@@ -142,25 +142,48 @@ def test_elastic_host_removal_end_to_end(tmp_path, mode):
     train = tmp_path / "train.py"
     train.write_text(_ELASTIC_TRAIN)
 
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "horovod_tpu.runner.launch",
-         "-np", "2", "--min-np", "1",
-         "--host-discovery-script", str(disc),
-         sys.executable, str(train)],
-        cwd=REPO_ROOT, text=True,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-    try:
-        time.sleep(4)  # let a few size-2 batches run
-        hosts_file.write_text("localhost:1\n")  # drop the second host
-        out, err = proc.communicate(timeout=120)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        out, err = proc.communicate()
-        raise AssertionError(f"elastic job hung\nstdout:\n{out}\nstderr:\n{err}")
+    out_path = tmp_path / "stdout.log"
+    err_path = tmp_path / "stderr.log"
+    with open(out_path, "w") as of, open(err_path, "w") as ef:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "2", "--min-np", "1",
+             "--host-discovery-script", str(disc),
+             sys.executable, str(train)],
+            cwd=REPO_ROOT, text=True, stdout=of, stderr=ef)
+        try:
+            # Drop the host only after batches PROVABLY ran at size 2 —
+            # worker startup time varies wildly (remote-backend imports),
+            # so a fixed sleep races the first rendezvous.
+            _wait_for_output(out_path, "size=2", proc, timeout=90)
+            hosts_file.write_text("localhost:1\n")  # drop the second host
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            raise AssertionError(
+                f"elastic job hung\nstdout:\n{out_path.read_text()}"
+                f"\nstderr:\n{err_path.read_text()}")
+    out, err = out_path.read_text(), err_path.read_text()
     assert proc.returncode == 0, (out, err)
     assert "ELASTIC_DONE" in out, (out, err)
-    assert "size=2" in out, "never ran at full size"
+    assert "size=2" in out, ("never ran at full size", err[-4000:])
     assert "size=1" in out, "never recovered at reduced size"
+
+
+def _wait_for_output(path, needle: str, proc, timeout: float) -> None:
+    """Poll a worker-output file until ``needle`` appears (or the job
+    exits / times out)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if needle in path.read_text():
+            return
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"job exited before producing {needle!r}:\n"
+                + path.read_text())
+        time.sleep(0.5)
+    raise AssertionError(f"timed out waiting for {needle!r} in output")
 
 
 _FAILING_TRAIN = """
@@ -326,3 +349,86 @@ def test_elastic_transient_exit_respawns_without_blacklist(tmp_path):
     # both ranks finish, and they finish at size 2 (host came back)
     assert proc.stdout.count("ELASTIC_DONE") == 2, proc.stdout[-1500:]
     assert "ELASTIC_DONE 0 size 2" in proc.stdout
+
+
+_XLA_ELASTIC_TRAIN = """
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.backend import xla as xla_backend
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0, dispatches_before_reset=-1)
+
+@hvd.elastic.run
+def train(state):
+    assert xla_backend.context().ready, "XLA data plane not up"
+    while state.batch < 60:
+        v = jnp.ones((8,), jnp.float32)
+        out = hvd.allreduce(v, op=hvd.Sum, name="grad")
+        np.testing.assert_allclose(np.asarray(out), hvd.size())
+        n = xla_backend.stats.get("allreduce", 0)
+        if state.dispatches_before_reset >= 0 and hvd.size() == 2:
+            # post-reset world: the DEVICE plane must be doing the work
+            assert n > state.dispatches_before_reset, (
+                n, state.dispatches_before_reset)
+            print(f"XLA_POST_RESET_DEVICE_PATH n={n}", flush=True)
+        print(f"BATCH {state.batch} rank={hvd.rank()} size={hvd.size()} "
+              f"xla_dispatches={n}", flush=True)
+        state.batch += 1
+        state.commit()
+        time.sleep(0.15)
+
+def on_reset():
+    # remember the dispatch count at reset; post-reset batches must grow it
+    state.dispatches_before_reset = xla_backend.stats.get("allreduce", 0)
+
+state.register_reset_callbacks([on_reset])
+train(state)
+print("XLA_ELASTIC_DONE", hvd.rank(), "size", hvd.size(), flush=True)
+hvd.shutdown()
+"""
+
+
+def test_elastic_xla_data_plane_survives_host_change(tmp_path):
+    """VERDICT r2 #5: with HOROVOD_DATA_PLANE=xla, a host removal must
+    re-establish jax.distributed + the device mesh for the NEW world —
+    stats counters prove post-reset collectives ride the device plane."""
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:1\n127.0.0.1:1\n127.0.0.2:1\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disc.chmod(0o755)
+    train = tmp_path / "train.py"
+    train.write_text(_XLA_ELASTIC_TRAIN)
+
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    out_path = tmp_path / "stdout.log"
+    err_path = tmp_path / "stderr.log"
+    with open(out_path, "w") as of, open(err_path, "w") as ef:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "3", "--min-np", "1", "--data-plane", "xla",
+             "--host-discovery-script", str(disc),
+             sys.executable, str(train)],
+            cwd=REPO_ROOT, text=True, env=env, stdout=of, stderr=ef)
+        try:
+            _wait_for_output(out_path, "size=3", proc, timeout=120)
+            hosts_file.write_text("localhost:1\n127.0.0.1:1\n")
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            raise AssertionError(
+                f"xla elastic job hung\nstdout:\n{out_path.read_text()}"
+                f"\nstderr:\n{err_path.read_text()}")
+    out, err = out_path.read_text(), err_path.read_text()
+    assert proc.returncode == 0, (out[-3000:], err[-3000:])
+    assert "XLA_ELASTIC_DONE" in out, (out[-3000:], err[-3000:])
+    assert "size=3" in out, "never ran at full size"
+    assert "XLA_POST_RESET_DEVICE_PATH" in out, \
+        "post-reset batches did not prove the device plane"

@@ -178,3 +178,141 @@ def test_spark_run_elastic_end_to_end():
         _elastic_fn, num_proc=2, min_np=1, sc=FakeSparkContext(),
         extra_env={"JAX_PLATFORMS": "cpu"}, start_timeout=60)
     assert results == [2.0, 2.0], results
+
+
+def test_prepare_dataset_partitionwise(tmp_path):
+    """Partitions materialize into per-part npz shards written BY TASKS;
+    the driver sees only metadata; validation rows split out."""
+    import numpy as np
+
+    from horovod_tpu.spark.common import LocalStore, prepare_dataset, read_shards
+    from tests.fake_spark import FakeDataFrame
+
+    rows = [{"features": [float(i), float(i) * 2], "label": float(i % 2)}
+            for i in range(40)]
+    df = FakeDataFrame(rows, num_partitions=4)
+    store = LocalStore(str(tmp_path))
+
+    manifest = prepare_dataset(df, store, ["features"], ["label"],
+                               validation=0.25, seed=3)
+    assert manifest["train_rows"] + manifest["val_rows"] == 40
+    assert manifest["val_rows"] > 0
+    assert len(manifest["train"]) <= 4
+    for p in manifest["train"]:
+        assert store.exists(p["path"])
+    assert store.exists("data/manifest.json")
+
+    # worker-side: two ranks read disjoint shard FILES, equalized lengths
+    a = read_shards(store, manifest, 0, 2)
+    b = read_shards(store, manifest, 1, 2)
+    assert len(a[0]) == len(b[0]) == -(-manifest["train_rows"] // 2)
+    va = read_shards(store, manifest, 0, 2, split="val")
+    assert len(va[0]) == -(-manifest["val_rows"] // 2)
+
+
+def test_keras_estimator_store_data_plane(tmp_path):
+    """VERDICT r2 #4 acceptance: estimator fit() where the dataset is
+    produced partition-wise — no whole-dataset collect() on the driver,
+    nothing dataset-sized pickled into tasks; per-epoch metrics logged
+    through the Store."""
+    keras = pytest.importorskip("keras")
+    import numpy as np
+
+    from horovod_tpu.spark.common import LocalStore
+    from horovod_tpu.spark.keras import KerasEstimator
+    from tests.fake_spark import FakeDataFrame
+
+    rng = np.random.RandomState(0)
+    rows = [{"features": rng.randn(4).astype("float32").tolist(),
+             "label": int(i % 2)} for i in range(64)]
+    df = FakeDataFrame(rows, num_partitions=4)
+    # guard: the Store path must never call collect()/select() on the df
+    df.collect = df.select = None  # would TypeError if touched
+
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    store = LocalStore(str(tmp_path))
+    est = KerasEstimator(
+        model=model, optimizer=keras.optimizers.Adam(0.01),
+        loss="sparse_categorical_crossentropy",
+        batch_size=16, epochs=2, num_proc=2, store=store,
+        validation=0.2, sc=FakeSparkContext())
+    fitted = est.fit(df)
+    assert fitted.predict(rng.randn(8, 4).astype("float32")).shape == (8, 2)
+    # epoch metric logs written through the store, with val_loss
+    assert store.exists("logs/epoch-0000.json")
+    assert store.exists("logs/epoch-0001.json")
+    import json
+    logs = json.loads(store.load_bytes("logs/epoch-0001.json"))
+    assert "loss" in logs and "val_loss" in logs, logs
+    # training history carries validation metrics per epoch
+    assert "val_loss" in fitted.history
+
+
+def test_torch_estimator_store_data_plane(tmp_path):
+    torch = pytest.importorskip("torch")
+    import json
+
+    import numpy as np
+
+    from horovod_tpu.spark.common import LocalStore
+    from horovod_tpu.spark.torch import TorchEstimator
+    from tests.fake_spark import FakeDataFrame
+
+    rng = np.random.RandomState(1)
+    rows = [{"features": rng.randn(4).astype("float32").tolist(),
+             "label": float(rng.rand() > 0.5)} for i in range(48)]
+    df = FakeDataFrame(rows, num_partitions=3)
+    df.collect = df.select = None
+
+    model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                torch.nn.Linear(8, 1))
+    store = LocalStore(str(tmp_path))
+    est = TorchEstimator(
+        model=model,
+        optimizer_factory=lambda p: torch.optim.SGD(p, lr=0.05),
+        loss=lambda out, y: torch.nn.functional.mse_loss(
+            out.squeeze(-1), y.float()),
+        batch_size=16, epochs=2, num_proc=2, store=store,
+        validation=0.25, sc=FakeSparkContext())
+    fitted = est.fit(df)
+    assert fitted.predict(rng.randn(5, 4).astype("float32")).shape[0] == 5
+    logs = json.loads(store.load_bytes("logs/epoch-0001.json"))
+    assert "loss" in logs and "val_loss" in logs, logs
+
+
+def test_read_shards_skewed_and_scarce(tmp_path):
+    """Row-balanced shard reading: skewed shard sizes drop no rows, and a
+    split with fewer shard files than ranks still feeds every rank."""
+    import io
+
+    import numpy as np
+
+    from horovod_tpu.spark.common import LocalStore, read_shards
+
+    store = LocalStore(str(tmp_path))
+    sizes = [100, 10]  # heavily skewed
+    off = 0
+    parts = []
+    for i, n in enumerate(sizes):
+        buf = io.BytesIO()
+        np.savez(buf, x=np.arange(off, off + n, dtype=np.float32)[:, None],
+                 y=np.zeros(n, np.float32))
+        store.save_bytes(f"d/part-{i}.npz", buf.getvalue())
+        parts.append({"path": f"d/part-{i}.npz", "rows": n})
+        off += n
+    manifest = {"train": parts, "train_rows": 110}
+
+    a = read_shards(store, manifest, 0, 2)
+    b = read_shards(store, manifest, 1, 2)
+    assert len(a[0]) == len(b[0]) == 55
+    seen = set(a[0].ravel().astype(int)) | set(b[0].ravel().astype(int))
+    assert seen == set(range(110)), "rows were dropped"
+
+    # one shard file, 4 ranks: every rank still gets ceil(10/4)=3 rows
+    m2 = {"train": parts[1:], "train_rows": 10}
+    lens = {r: len(read_shards(store, m2, r, 4)[0]) for r in range(4)}
+    assert set(lens.values()) == {3}, lens
