@@ -88,6 +88,15 @@ class Controller:
         self._cycle_evictions: List[int] = []
         self.cache_hit_count = 0
         self.cache_miss_count = 0
+        # Mask fast path (coordinator): per-rank pending cache-bit masks,
+        # aggregated with big-int AND/OR — O(ranks) C-speed work per cycle
+        # instead of O(ranks × tensors) Python (reference bitvector
+        # allreduce role, ``mpi_controller.cc:88-106``).
+        self._pending_masks: Dict[int, int] = {}
+        self._mask_bit_since: Dict[int, float] = {}
+        # Tensors completed by a stall-time bit→table conversion (after this
+        # cycle's responses were already built); delivered next cycle.
+        self._stall_completed: List[str] = []
         # FIFO completion order like the reference: responses are emitted in
         # the order tensors *complete*, which is deterministic because only
         # the coordinator decides it.
@@ -120,8 +129,13 @@ class Controller:
             requests = misses
             self.cache_hit_count += len(hits)
             self.cache_miss_count += len(requests)
-        payload = RequestList(requests=requests, shutdown=should_shutdown,
-                              cache_hits=hits).to_bytes()
+        mask = 0
+        for bit in hits:
+            mask |= 1 << bit
+        payload = RequestList(
+            requests=requests, shutdown=should_shutdown,
+            cache_mask=mask.to_bytes((mask.bit_length() + 7) // 8,
+                                     "little")).to_bytes()
         self.mesh.send(0, payload)
         rlist = ResponseList.from_bytes(self.mesh.recv(0))
         if self._mirror is not None:
@@ -132,23 +146,30 @@ class Controller:
 
     def _coordinator_round(self, own_requests: List[Request],
                            should_shutdown: bool) -> ResponseList:
+        from .response_cache import CACHEABLE, cache_key
+
         self._cycle_assignments = []
         self._cycle_evictions = []
-        ready: List[str] = []
+        ready: List[str] = list(self._stall_completed)
+        self._stall_completed.clear()
+        pending = self._pending_masks
         for req in own_requests:
-            if self._increment(req):
+            bit = self._cache.lookup(cache_key(req)) \
+                if self._cache is not None \
+                and req.request_type in CACHEABLE else None
+            if bit is not None:
+                pending[0] = pending.get(0, 0) | (1 << bit)
+                self.cache_hit_count += 1
+            elif self._increment(req):
                 ready.append(req.tensor_name)
         for worker in range(1, self.topo.size):
             rl = RequestList.from_bytes(self.mesh.recv(worker))
             should_shutdown = should_shutdown or rl.shutdown
-            for bit in rl.cache_hits:
-                req = self._cache.rehydrate(bit, worker) \
-                    if self._cache is not None else None
-                if req is None:
-                    log.error("rank %d hit unknown cache bit %d", worker, bit)
-                    continue
-                if self._increment(req):
-                    ready.append(req.tensor_name)
+            if rl.cache_mask:
+                pending[worker] = pending.get(worker, 0) | int.from_bytes(
+                    rl.cache_mask, "little")
+            for bit in rl.cache_hits:  # legacy list flavor
+                pending[worker] = pending.get(worker, 0) | (1 << bit)
             for req in rl.requests:
                 if self._increment(req):
                     ready.append(req.tensor_name)
@@ -168,6 +189,7 @@ class Controller:
 
         responses = [self._construct_response(name) for name in ready]
         responses = [r for r in responses if r is not None]
+        responses.extend(self._mask_round(pending))
         tuned = self._autotune(responses)
         responses = self._fuse_responses(responses)
         self._check_stalls()
@@ -182,6 +204,147 @@ class Controller:
         for worker in range(1, self.topo.size):
             self.mesh.send(worker, payload)
         return rlist
+
+    def _mask_round(self, pending: Dict[int, int]) -> List[Response]:
+        """Resolve the cache-bit masks: a bit set in EVERY active rank's
+        pending mask is globally ready and its Response comes straight from
+        the cached template (no per-rank tallying or re-validation — a hit
+        means the rank's request matched the template key exactly).
+
+        Also merges the transition case where some ranks sent a bit while
+        others sent a full Request for the same tensor (e.g. around an
+        eviction): those bits convert into table tallies so neither side
+        strands."""
+        if not pending:
+            return []
+        responses: List[Response] = []
+        if self._cycle_evictions:
+            # A bit evicted this cycle may still be pending on some ranks
+            # (partial announcement): convert those announcements to table
+            # tallies via the tombstoned template so the bit id can be
+            # recycled safely once its tombstone expires.
+            from dataclasses import replace as _replace
+
+            for bit in self._cycle_evictions:
+                low = 1 << bit
+                if not any(m & low for m in pending.values()):
+                    continue
+                tpl = self._cache.rehydrate(bit, 0) if self._cache else None
+                completed = False
+                for r, m in list(pending.items()):
+                    if m & low:
+                        pending[r] = m & ~low
+                        if tpl is not None:
+                            completed |= self._increment(
+                                _replace(tpl, request_rank=r))
+                self._mask_bit_since.pop(bit, None)
+                if completed:
+                    resp = self._construct_response(tpl.tensor_name)
+                    if resp is not None:
+                        responses.append(resp)
+
+        union = 0
+        for m in pending.values():
+            union |= m
+        if union == 0:
+            return responses
+
+        ready_mask = None
+        for r in range(self.topo.size):
+            eff = -1 if r in self._joined_ranks else pending.get(r, 0)
+            ready_mask = eff if ready_mask is None else (ready_mask & eff)
+            if ready_mask == 0:
+                break
+        ready_mask = ready_mask or 0
+        if ready_mask:
+            # One big-int op per rank clears every completing bit (the
+            # per-bit/per-rank loop this path exists to avoid).
+            for r, m in list(pending.items()):
+                pending[r] = m & ~ready_mask
+
+        rm = ready_mask
+        while rm:
+            low = rm & -rm
+            bit = low.bit_length() - 1
+            rm ^= low
+            self._mask_bit_since.pop(bit, None)
+            tpl = self._cache.rehydrate(bit, 0) if self._cache else None
+            if tpl is None:
+                log.error("ready unknown cache bit %d; dropping", bit)
+                continue
+            if tpl.request_type == RequestType.BROADCAST and \
+                    self._joined_ranks:
+                responses.append(Response(
+                    response_type=ResponseType.ERROR,
+                    tensor_names=[tpl.tensor_name],
+                    error_message=f"broadcast for {tpl.tensor_name} cannot "
+                                  "complete with joined ranks (Join "
+                                  "supports allreduce only)."))
+                continue
+            responses.append(self._response_from_template(tpl))
+
+        # Leftover bits (present on SOME ranks only): start their stall
+        # clock and merge with any same-tensor full-Request tally so mixed
+        # bit/Request submissions cannot strand each other.  Steady state
+        # (every bit completes in its cycle) leaves this loop empty.
+        leftover = union & ~ready_mask
+        if leftover:
+            from dataclasses import replace as _replace
+
+            now = time.monotonic()
+            while leftover:
+                low = leftover & -leftover
+                bit = low.bit_length() - 1
+                leftover ^= low
+                self._mask_bit_since.setdefault(bit, now)
+                tpl = self._cache.rehydrate(bit, 0) if self._cache else None
+                if tpl is None:
+                    log.error("pending unknown cache bit %d; dropping", bit)
+                    self._clear_bit(bit)
+                    continue
+                if tpl.tensor_name in self._message_table:
+                    completed = False
+                    for r, m in list(pending.items()):
+                        if m & low:
+                            pending[r] = m & ~low
+                            completed |= self._increment(
+                                _replace(tpl, request_rank=r))
+                    self._mask_bit_since.pop(bit, None)
+                    if completed:
+                        resp = self._construct_response(tpl.tensor_name)
+                        if resp is not None:
+                            responses.append(resp)
+        return responses
+
+    def _clear_bit(self, bit: int) -> None:
+        low = 1 << bit
+        for r, m in list(self._pending_masks.items()):
+            if m & low:
+                self._pending_masks[r] = m & ~low
+        self._mask_bit_since.pop(bit, None)
+
+    def _response_from_template(self, tpl: Request) -> Response:
+        """Response for a fully-hit cached tensor — field-for-field what
+        ``_construct_response`` emits for a validated single-tensor
+        ALLREDUCE/ADASUM/BROADCAST (the only cacheable ops)."""
+        rtype = {
+            RequestType.ALLREDUCE: ResponseType.ALLREDUCE,
+            RequestType.ADASUM: ResponseType.ADASUM,
+            RequestType.BROADCAST: ResponseType.BROADCAST,
+        }[tpl.request_type]
+        resp = Response(
+            response_type=rtype,
+            tensor_names=[tpl.tensor_name],
+            tensor_type=tpl.tensor_type,
+            tensor_sizes=[tpl.num_elements],
+            devices=[tpl.device],
+            prescale_factor=tpl.prescale_factor,
+            postscale_factor=tpl.postscale_factor,
+            last_joined_rank=min(self._joined_ranks)
+            if self._joined_ranks else -1,
+        )
+        resp._payload_bytes = tpl.num_elements * tpl.tensor_type.itemsize
+        return resp
 
     def _autotune(self, responses: List[Response]):
         """Feed the cycle's reduced byte volume to the ParameterManager;
@@ -496,6 +659,45 @@ class Controller:
                 bit = self._cache.invalidate_name(name)
                 if bit is not None:
                     self._cycle_evictions.append(bit)
+
+        # Mask-path stalls: a bit some ranks announced long ago that never
+        # reached all ranks.  Convert the partial announcements into table
+        # tallies (so the waiting ranks eventually resolve — typically as a
+        # loud mismatch/stall on the table path) and invalidate the entry.
+        from dataclasses import replace as _replace
+
+        for bit, since in list(self._mask_bit_since.items()):
+            age = now - since
+            have = [r for r, m in self._pending_masks.items()
+                    if m & (1 << bit)]
+            missing = sorted(set(range(self.topo.size)) - set(have)
+                             - self._joined_ranks)
+            if shut > 0 and age > shut:
+                from ..common.exceptions import HorovodInternalError
+
+                tpl = self._cache.rehydrate(bit, 0) if self._cache else None
+                name = tpl.tensor_name if tpl else f"<bit {bit}>"
+                raise HorovodInternalError(
+                    f"stall shutdown: cached tensor {name} incomplete for "
+                    f"{age:.0f}s (> {shut}s), missing ranks {missing}")
+            if warn <= 0 or age <= warn:
+                continue
+            tpl = self._cache.rehydrate(bit, 0) if self._cache else None
+            if tpl is None:
+                self._clear_bit(bit)
+                continue
+            log.warning(
+                "cached tensor %s announced by ranks %s stalled for %.0fs, "
+                "missing ranks: %s — invalidating its cache entry",
+                tpl.tensor_name, have, age, missing)
+            for r in have:
+                self._pending_masks[r] &= ~(1 << bit)
+                if self._increment(_replace(tpl, request_rank=r)):
+                    self._stall_completed.append(tpl.tensor_name)
+            self._mask_bit_since.pop(bit, None)
+            evicted = self._cache.invalidate_name(tpl.tensor_name)
+            if evicted is not None:
+                self._cycle_evictions.append(evicted)
 
     # ------------------------------------------------------------------
     # small collective helpers for init/shutdown/elastic paths

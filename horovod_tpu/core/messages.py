@@ -262,12 +262,19 @@ class RequestList:
     # 4 bytes instead of a full Request — the steady-state fast path
     # (reference bitvector sync, ``controller.cc:826-851``).
     cache_hits: List[int] = field(default_factory=list)
+    # Dense bitmask flavor of the same information (little-endian bytes of
+    # a big integer): the coordinator aggregates these with C-speed
+    # integer AND/OR instead of per-(rank × tensor) Python loops — the
+    # part of the star protocol that must stay O(ranks) per cycle.
+    cache_mask: bytes = b""
 
     def to_bytes(self) -> bytes:
         w = Writer()
         w.u32(WIRE_MAGIC)
         w.u8(1 if self.shutdown else 0)
         w.i32_list(self.cache_hits)
+        w.u32(len(self.cache_mask))
+        w.buf += self.cache_mask
         w.u32(len(self.requests))
         for req in self.requests:
             req.serialize(w)
@@ -280,9 +287,12 @@ class RequestList:
             raise ValueError("bad request-list magic")
         shutdown = bool(r.u8())
         cache_hits = r.i32_list()
+        mask_len = r.u32()
+        mask = bytes(r.buf[r.pos:r.pos + mask_len])
+        r.pos += mask_len
         reqs = [Request.deserialize(r) for _ in range(r.u32())]
         return RequestList(requests=reqs, shutdown=shutdown,
-                           cache_hits=cache_hits)
+                           cache_hits=cache_hits, cache_mask=mask)
 
 
 @dataclass

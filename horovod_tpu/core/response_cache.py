@@ -53,6 +53,13 @@ class CoordinatorCache:
         self._by_name: Dict[str, int] = {}
         self._tombstones: Dict[int, Tuple[Request, int]] = {}
         self._next_bit = 0
+        # Recycled ids (evicted + tombstone expired): keeps the dense
+        # bitmask wire format bounded by ~capacity bits instead of growing
+        # with the total number of assignments ever made.  Safe because the
+        # round trip is synchronous (a hit is consumed the same cycle it is
+        # sent) and the controller converts lingering pending bits to table
+        # tallies when their entry is evicted.
+        self._free_bits: List[int] = []
 
     def lookup(self, key: Tuple) -> Optional[int]:
         bit = self._by_key.get(key)
@@ -90,8 +97,11 @@ class CoordinatorCache:
             old_bit = next(iter(self._by_bit))
             self._evict(old_bit)
             evicted.append(old_bit)
-        bit = self._next_bit
-        self._next_bit += 1
+        if self._free_bits:
+            bit = self._free_bits.pop()
+        else:
+            bit = self._next_bit
+            self._next_bit += 1
         template = replace(req, request_rank=0)
         self._by_bit[bit] = (key, template)
         self._by_key[key] = bit
@@ -121,7 +131,7 @@ class CoordinatorCache:
         self._tombstones[bit] = (template, _TOMBSTONE_CYCLES)
 
     def tick(self) -> None:
-        """Age tombstones one cycle."""
+        """Age tombstones one cycle; expired ids return to the free pool."""
         dead = []
         for bit, (tpl, left) in self._tombstones.items():
             if left <= 1:
@@ -130,6 +140,7 @@ class CoordinatorCache:
                 self._tombstones[bit] = (tpl, left - 1)
         for bit in dead:
             self._tombstones.pop(bit, None)
+            self._free_bits.append(bit)
 
     def __len__(self) -> int:
         return len(self._by_bit)
@@ -147,11 +158,16 @@ class WorkerCacheMirror:
 
     def apply(self, assignments: List[Tuple[int, Request]],
               evicted_bits: List[int]) -> None:
-        # Assignments first: bit ids are never reused, so an eviction in the
-        # same batch is always the *later* event for its bit (a capacity
-        # eviction can hit a bit assigned earlier in the same cycle).
+        # Assignments first: within one batch an eviction is always the
+        # *later* event for its bit (a capacity eviction can hit a bit
+        # assigned earlier in the same cycle).  Bit ids RECYCLE after their
+        # tombstone expires, so an assignment overwriting a known bit must
+        # also drop the stale key that previously mapped to it.
         for bit, template in assignments:
             key = cache_key(template)
+            stale = self._by_bit.get(bit)
+            if stale is not None and stale != key:
+                self._by_key.pop(stale, None)
             self._by_key[key] = bit
             self._by_bit[bit] = key
         for bit in evicted_bits:

@@ -290,7 +290,16 @@ def run_elastic(fn: Callable, args: tuple = (),
     ``spark/runner.py:303``): Spark provides up to ``num_proc`` task
     slots, the shared ElasticDriver assigns ranks and survives task loss
     down to ``min_np`` (Spark's own task retry provides replacement
-    hosts); returns the successful ranks' results."""
+    hosts).
+
+    Returns a list indexed by FINAL rank (the assignment in force when the
+    job wound down).  **Partial-results contract**: after mid-run
+    failures/resizes, entries for ranks whose last incarnation did not
+    report a result are ``None`` — the job succeeds as long as at least
+    one rank reported (rank 0's host being pruned mid-run is survivable;
+    re-ranked survivors' results land at their final indices).  Callers
+    needing one definitive value should read the first non-``None`` entry
+    or have every rank return the coordinator-broadcast state."""
     from ..elastic.discovery import HostDiscovery, HostManager
     from ..elastic.driver import ElasticDriver
     from ..elastic.registration import FAILURE
@@ -425,7 +434,10 @@ def run_elastic(fn: Callable, args: tuple = (),
             blob = server.get(_RESULT_SCOPE, identity)
             if blob is not None:
                 out[rank_] = _loads(blob)
-        return [out[r] for r in sorted(out)]
+        # Final-rank-indexed, None for ranks whose last incarnation never
+        # reported (the partial-results contract in the docstring).
+        width = max(out) + 1 if out else 0
+        return [out.get(r) for r in range(width)]
     finally:
         monitor_stop.set()
         driver.stop()

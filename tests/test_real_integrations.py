@@ -1,0 +1,125 @@
+"""Integration smokes against the REAL ray / pyspark / mxnet libraries.
+
+VERDICT r2 #8: `tests/fake_ray.py` / `tests/fake_spark.py` encode the
+builder's *belief* about those APIs; nothing checked the belief.  These
+tests run the same surfaces against the genuine libraries — they skip
+cleanly when a library is absent (the default CI image has none of the
+three) and run in the dedicated lane (`ci/real_integrations.sh`, pinned
+versions in `ci/requirements-integrations.txt`).
+
+Reference analog: `test/single/test_ray.py` uses real
+``ray.init(local_mode=True)``; `test/integration/test_spark*.py` uses a
+real local pyspark session.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+class TestRealRay:
+    def setup_method(self):
+        ray = pytest.importorskip("ray", reason="real-ray lane only")
+        ray.init(local_mode=True, ignore_reinit_error=True,
+                 include_dashboard=False)
+
+    def teardown_method(self):
+        import ray
+
+        ray.shutdown()
+
+    def test_ray_executor_single_slot(self):
+        from horovod_tpu.ray import RayExecutor
+
+        ex = RayExecutor(RayExecutor.create_settings(), num_workers=1)
+        ex.start()
+        try:
+            def fn():
+                import horovod_tpu as hvd
+
+                hvd.init()
+                out = np.asarray(hvd.allreduce(
+                    np.ones(3, np.float32), op=hvd.Sum, name="t"))
+                r = hvd.rank()
+                hvd.shutdown()
+                return r, out.tolist()
+
+            results = ex.run(fn)
+            assert results[0][0] == 0
+            assert results[0][1] == [1.0, 1.0, 1.0]
+        finally:
+            ex.shutdown()
+
+
+def test_real_pyspark_run():
+    pyspark = pytest.importorskip("pyspark", reason="real-pyspark lane only")
+    from pyspark import SparkConf, SparkContext
+
+    import horovod_tpu.spark as hvd_spark
+
+    conf = SparkConf().setMaster("local[2]").setAppName("hvd-real-spark")
+    sc = SparkContext.getOrCreate(conf)
+    try:
+        def task():
+            import horovod_tpu as hvd
+
+            hvd.init()
+            out = np.asarray(hvd.allreduce(
+                np.ones(2, np.float32) * (hvd.rank() + 1),
+                op=hvd.Sum, name="s"))
+            r, s = hvd.rank(), hvd.size()
+            hvd.shutdown()
+            return r, s, out.tolist()
+
+        results = hvd_spark.run(task, num_proc=2, sc=sc)
+        assert sorted(r[0] for r in results) == [0, 1]
+        assert all(r[1] == 2 for r in results)
+        assert all(r[2] == [3.0, 3.0] for r in results)
+    finally:
+        sc.stop()
+
+
+def test_real_pyspark_estimator_store_plane(tmp_path):
+    pyspark = pytest.importorskip("pyspark", reason="real-pyspark lane only")
+    keras = pytest.importorskip("keras")
+    from pyspark.sql import SparkSession
+
+    from horovod_tpu.spark.common import LocalStore, prepare_dataset, read_shards
+
+    spark = SparkSession.builder.master("local[2]").getOrCreate()
+    try:
+        rows = [([float(i), float(i * 2)], float(i % 2)) for i in range(20)]
+        df = spark.createDataFrame(rows, ["features", "label"]) \
+            .repartition(4)
+        store = LocalStore(str(tmp_path))
+        manifest = prepare_dataset(df, store, ["features"], ["label"],
+                                   validation=0.2)
+        assert manifest["train_rows"] + manifest["val_rows"] == 20
+        x, y = read_shards(store, manifest, 0, 2)
+        assert x.shape[1] == 2
+    finally:
+        spark.stop()
+
+
+def test_real_mxnet_binding_smoke():
+    mx = pytest.importorskip("mxnet", reason="real-mxnet lane only")
+
+    import horovod_tpu.mxnet as hvd
+
+    hvd.init()
+    try:
+        x = mx.nd.ones((4,))
+        out = hvd.allreduce(x, name="mx.t")
+        assert np.allclose(out.asnumpy(), np.ones(4))
+        # DistributedTrainer wraps a Gluon trainer end-to-end
+        net = mx.gluon.nn.Dense(2)
+        net.initialize()
+        trainer = hvd.DistributedTrainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.1})
+        with mx.autograd.record():
+            loss = net(mx.nd.ones((3, 4))).sum()
+        loss.backward()
+        trainer.step(3)
+    finally:
+        hvd.shutdown()
