@@ -326,6 +326,85 @@ class XlaContext:
 
         return self._get(key, build)
 
+    def adasum_fn(self, shapes: Tuple, bucket: int, np_dtype,
+                  prescale: float, postscale: float) -> Callable:
+        """[P, bucket] sharded → per-entry outputs after a full on-device
+        Adasum VHDD (see :class:`XlaAdasum`).  One compiled computation:
+        log2(P) ppermute rounds with per-entry dot/norm combines."""
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("adasum", shapes, bucket, str(np_dtype), prescale, postscale)
+
+        def build():
+            size = self.topo.size
+            dt = np.dtype(np_dtype)
+            sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+            bounds = np.concatenate([[0], np.cumsum(sizes)])
+            rounds = max(size - 1, 0).bit_length()  # log2 for powers of 2
+
+            def combine(a, b):
+                # Per-entry operator; fp32 accumulation (reference uses
+                # f64 host accumulators; fp64 is emulated on TPU).
+                outs = []
+                for i in range(len(shapes)):
+                    ae = a[bounds[i]:bounds[i + 1]].astype(jnp.float32)
+                    be = b[bounds[i]:bounds[i + 1]].astype(jnp.float32)
+                    dot = jnp.sum(ae * be)
+                    na = jnp.sum(ae * ae)
+                    nb = jnp.sum(be * be)
+                    ca = jnp.where(na > 0, 1.0 - dot / (2 * na), 1.0)
+                    cb = jnp.where(nb > 0, 1.0 - dot / (2 * nb), 1.0)
+                    outs.append(ca * ae + cb * be)
+                if bucket > bounds[-1]:
+                    outs.append(jnp.zeros((int(bucket - bounds[-1]),),
+                                          jnp.float32))
+                return jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+
+            def f(x):  # [1, bucket] local block
+                v = x.reshape(-1).astype(jnp.float32)
+                if prescale != 1.0:
+                    v = v * prescale
+                for k in range(rounds):
+                    stride = 1 << k
+                    # pair exchange: r <-> r XOR stride
+                    perm = [(r, r ^ stride) for r in range(size)]
+                    other = jax.lax.ppermute(v, "proc", perm)
+                    v = combine(v, other)
+                if postscale != 1.0:
+                    v = v * postscale
+                out = v.astype(dt)
+                return tuple(
+                    out[bounds[i]:bounds[i + 1]].reshape(shapes[i])
+                    for i in range(len(shapes)))
+
+            if size == 1:
+                def f1(x):
+                    v = x.reshape(-1).astype(jnp.float32)
+                    scale = prescale * postscale
+                    if scale != 1.0:
+                        v = v * scale
+                    out = v.astype(dt)
+                    return tuple(
+                        out[bounds[i]:bounds[i + 1]].reshape(shapes[i])
+                        for i in range(len(shapes)))
+
+                return jax.jit(f1)
+
+            in_sh = NamedSharding(self.mesh, P("proc"))
+            rep = NamedSharding(self.mesh, P())
+            # check_vma off: after the last VHDD round every rank holds the
+            # same value, but the tracer cannot prove ppermute outputs
+            # replicated.
+            return jax.jit(
+                shard_map(f, mesh=self.mesh, in_specs=P("proc"),
+                          out_specs=P(), check_vma=False),
+                in_shardings=(in_sh,), out_shardings=rep)
+
+        return self._get(key, build)
+
     def allgather_fn(self, bucket: int, np_dtype) -> Callable:
         """[P, bucket] sharded → [P, bucket] replicated (XLA AllGather)."""
         import jax
@@ -735,6 +814,47 @@ class XlaAlltoall(XlaOp):
             return jax.jit(f)
 
         return ctx._get(unpack_key, build_unpack)(mine)
+
+
+class XlaAdasum(XlaOp):
+    """Adasum VHDD entirely on the device mesh (role of the reference's
+    GPU-staged Adasum, ``adasum_gpu_operations.cc:38-100`` — which had to
+    hop through the host for the cross-node combine; XLA collectives let
+    the whole recursion stay on-device).
+
+    log2(P) rounds under ``shard_map``: round k pairs rank r with
+    r XOR 2^k via ``ppermute``, then combines per ENTRY with the Adasum
+    operator a' = (1 − a·b/2‖a‖²)·a + (1 − a·b/2‖b‖²)·b (dot/norms in
+    fp32, per-tensor within the fused buffer exactly like the reference's
+    per-layer dispatch, ``adasum.h:194-450``).  Requires a power-of-two
+    world; otherwise the chain falls through to the host backends."""
+
+    def enabled(self, response: Response,
+                entries: List[TensorTableEntry]) -> bool:
+        p = self.topo.size
+        return (response.response_type == ResponseType.ADASUM
+                and (p & (p - 1)) == 0
+                and self._common_enabled(response, entries))
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        import jax
+
+        ctx = self.ctx
+        np_dtype = response.tensor_type.to_numpy()
+        shapes = tuple(tuple(e.tensor.shape) for e in entries)
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        total = sum(sizes)
+        bucket = bucket_elems(total)
+        fused = ctx.fuse(entries, bucket, np_dtype)
+        fn = ctx.adasum_fn(shapes, bucket, np_dtype,
+                           response.prescale_factor,
+                           response.postscale_factor)
+        outs = fn(ctx.global_input(fused))
+        for e, o in zip(entries, outs):
+            e.output = o
+        _count("adasum")
+        return Status.dispatched()
 
 
 class XlaBroadcast(XlaOp):

@@ -211,6 +211,10 @@ class HorovodGlobalState:
             ResponseType.ALLTOALL, cpu_ring.PairwiseAlltoall(topo, mesh))
         from ..backend.adasum import AdasumAllreduce, AdasumRingFallback
 
+        # Device VHDD ahead of the host backends (like the reference's
+        # AdasumGpu ahead of AdasumMPI, operations.cc registration order).
+        self.op_manager.register(
+            ResponseType.ADASUM, xla_backend.XlaAdasum(topo))
         self.op_manager.register(
             ResponseType.ADASUM, AdasumAllreduce(topo, mesh, fbm))
         # Non-power-of-two worlds fall back to an averaging ring allreduce

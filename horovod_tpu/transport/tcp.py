@@ -137,32 +137,87 @@ class TcpMesh:
 
     def _dial_peer(self, target: int, endpoints: List,
                    timeout: float) -> socket.socket:
+        """Connect to one peer, racing the TCP connects to ALL advertised
+        candidates concurrently (reference driver probe-and-intersect
+        role, ``driver/driver_service.py:162-194``): on a multi-homed host
+        a dead first candidate costs nothing — a reachable one wins the
+        race instead of waiting out the dead one's timeout serially.
+
+        Only the CONNECT races; the hello handshake runs serially on one
+        socket at a time.  Losing sockets close before any hello, so the
+        acceptor sees EOF and drops them without registering — racing full
+        handshakes could leave dialer and acceptor registered on
+        *different* winners for the same rank pair."""
+        import queue as queue_mod
+
         deadline = time.monotonic() + timeout
-        last: Optional[Exception] = None
-        while time.monotonic() < deadline:
-            for host, port in endpoints:
+        last: List[Optional[Exception]] = [None]
+
+        def connect_all() -> List[socket.socket]:
+            if len(endpoints) == 1:
+                host, port = endpoints[0]
                 try:
-                    sock = socket.create_connection(
-                        (host, port), timeout=min(5.0, timeout))
-                    _configure(sock)
-                    # Bounded handshake: an endpoint that accepts but never
-                    # answers must fall through to the next candidate, not
-                    # hang the mesh (symmetric with the accept side).
-                    sock.settimeout(5.0)
-                    sock.sendall(self._hello_blob(self.rank, target))
-                    got, _ = self._check_hello(
-                        _recv_exact(sock, self._hello_len()))
-                    if got != target:
-                        sock.close()
-                        raise HorovodInternalError(
-                            f"{host}:{port} answered as rank {got}")
-                    sock.settimeout(None)
-                    return sock
+                    return [socket.create_connection(
+                        (host, port), timeout=min(5.0, timeout))]
+                except OSError as e:
+                    last[0] = e
+                    return []
+            results: "queue_mod.Queue" = queue_mod.Queue()
+
+            def conn(host, port):
+                try:
+                    results.put(socket.create_connection(
+                        (host, port), timeout=min(5.0, timeout)))
+                except OSError as e:
+                    last[0] = e
+                    results.put(None)
+
+            for host, port in endpoints:
+                threading.Thread(target=conn, args=(host, port),
+                                 daemon=True).start()
+            socks = []
+            for _ in endpoints:
+                try:
+                    s = results.get(
+                        timeout=max(0.1, deadline - time.monotonic()))
+                except queue_mod.Empty:
+                    break
+                if s is not None:
+                    socks.append(s)
+                elif socks:
+                    break  # have a candidate; don't wait for stragglers
+            return socks
+
+        while time.monotonic() < deadline:
+            socks = connect_all()
+            winner: Optional[socket.socket] = None
+            for i, sock in enumerate(socks):
+                if winner is not None:
+                    sock.close()  # pre-hello close: acceptor drops on EOF
+                    continue
+                try:
+                    winner = self._handshake(sock, target)
                 except (OSError, HorovodInternalError) as e:
-                    last = e
+                    last[0] = e
+                    sock.close()
+            if winner is not None:
+                return winner
             time.sleep(0.05)
         raise HorovodInternalError(
-            f"could not connect to rank {target} at {endpoints}: {last}")
+            f"could not connect to rank {target} at {endpoints}: {last[0]}")
+
+    def _handshake(self, sock: socket.socket, target: int) -> socket.socket:
+        _configure(sock)
+        # Bounded handshake: an endpoint that accepts but never answers
+        # must fall through to the next candidate, not hang the mesh
+        # (symmetric with the accept side).
+        sock.settimeout(5.0)
+        sock.sendall(self._hello_blob(self.rank, target))
+        got, _ = self._check_hello(_recv_exact(sock, self._hello_len()))
+        if got != target:
+            raise HorovodInternalError(f"peer answered as rank {got}")
+        sock.settimeout(None)
+        return sock
 
     def _accept_loop(self, n_expected: int, err: List[BaseException],
                      timeout: float) -> None:

@@ -271,3 +271,41 @@ def test_tcp_mesh_multi_addr_fallback():
     assert res[0].recv(1) == b"hi"
     for m in res:
         m.close()
+
+
+def test_tcp_mesh_dead_first_candidate_races_fast():
+    """Multi-addr dialing probes candidates CONCURRENTLY: a dead first
+    candidate (blackhole address) must not serialize a connect timeout in
+    front of the live one (reference probe-and-intersect role)."""
+    import time as time_mod
+
+    store = MemoryStore()
+
+    class DeadFirstStore(MemoryStore):
+        """Prepends an unroutable candidate to every advertisement."""
+
+        def set(self, scope, key, value):
+            if scope.startswith("tcp") or scope == "tcp":
+                spec = value.decode()
+                port = spec.rsplit(":", 1)[1]
+                value = f"10.255.255.1:{port},{spec}".encode()
+            super().set(scope, key, value)
+
+    dead_store = DeadFirstStore()
+
+    def fn(rank):
+        t0 = time_mod.monotonic()
+        mesh = TcpMesh(rank, 2, dead_store, bind_addr="127.0.0.1",
+                       timeout=20)
+        dt = time_mod.monotonic() - t0
+        try:
+            mesh.send(1 - rank, b"hi")
+            assert mesh.recv(1 - rank) == b"hi"
+        finally:
+            mesh.close()
+        return dt
+
+    times = run_ranks(2, fn)
+    # serial probing would eat the ~5s connect timeout on the dead
+    # candidate first; the concurrent race finishes in well under that
+    assert max(times) < 4.0, times

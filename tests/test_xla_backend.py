@@ -192,3 +192,51 @@ print("XLA_A2A_OK", rank, flush=True)
 """, extra_env=_xla_env())
     for r, o in enumerate(out):
         assert f"XLA_A2A_OK {r}" in o
+
+
+def test_xla_device_adasum_two_ranks_matches_closed_form():
+    """On-device VHDD (XlaAdasum): 2-rank result equals the closed-form
+    operator; stats prove the device path ran (reference GPU-Adasum role,
+    ``adasum_gpu_operations.cc:38-100``)."""
+    out = run_distributed(2, _ASSERT_XLA + """
+import jax.numpy as jnp
+
+a = jnp.asarray(np.array([1.0, 0.5, -1.0], np.float32) * (rank + 1))
+res = np.asarray(hvd.allreduce(a, op=hvd.Adasum, name="dev.adasum"))
+
+g0 = np.array([1.0, 0.5, -1.0]); g1 = 2 * g0
+dot = g0 @ g1
+exp = (1 - dot/(2*(g0@g0)))*g0 + (1 - dot/(2*(g1@g1)))*g1
+assert np.allclose(res, exp, atol=1e-5), (res, exp)
+assert stats.get("adasum", 0) >= 1, stats
+print("XLA_ADASUM_OK", rank, flush=True)
+""", extra_env=_xla_env())
+    for r, o in enumerate(out):
+        assert f"XLA_ADASUM_OK {r}" in o
+
+
+def test_xla_device_adasum_four_ranks_tree():
+    """4 ranks: the on-device recursion must equal the host VHDD tree —
+    pairwise combine (0,1) and (2,3), then combine the pair results."""
+    out = run_distributed(4, _ASSERT_XLA + """
+import jax.numpy as jnp
+
+def combine(a, b):
+    dot = float(a @ b); na = float(a @ a); nb = float(b @ b)
+    ca = 1 - dot/(2*na) if na else 1.0
+    cb = 1 - dot/(2*nb) if nb else 1.0
+    return ca*a + cb*b
+
+vecs = [np.array([1.0, 2.0], np.float32),
+        np.array([0.5, -1.0], np.float32),
+        np.array([2.0, 0.0], np.float32),
+        np.array([-1.0, 1.0], np.float32)]
+mine = jnp.asarray(vecs[rank])
+res = np.asarray(hvd.allreduce(mine, op=hvd.Adasum, name="dev.adasum4"))
+exp = combine(combine(vecs[0], vecs[1]), combine(vecs[2], vecs[3]))
+assert np.allclose(res, exp, atol=1e-4), (res, exp)
+assert stats.get("adasum", 0) >= 1, stats
+print("XLA_ADASUM4_OK", rank, flush=True)
+""", extra_env=_xla_env())
+    for r, o in enumerate(out):
+        assert f"XLA_ADASUM4_OK {r}" in o
