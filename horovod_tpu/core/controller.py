@@ -256,6 +256,10 @@ class Controller:
             if ready_mask == 0:
                 break
         ready_mask = ready_mask or 0
+        # Bound to announced bits: with every rank joined each eff is -1 and
+        # the AND-fold yields -1 (infinite sign-extended mask) — the bit
+        # extraction loop below would never terminate on a negative int.
+        ready_mask &= union
         if ready_mask:
             # One big-int op per rank clears every completing bit (the
             # per-bit/per-rank loop this path exists to avoid).

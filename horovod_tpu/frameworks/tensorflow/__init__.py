@@ -584,6 +584,33 @@ def _build_sync_batch_norm():
         return _sync_bn_class
     tf = _tf()
 
+    # The override below matches Keras 3's ``_moments(self, inputs, mask)``.
+    # Legacy Keras 2 / tf.keras used ``_moments(inputs, reduction_axes,
+    # keep_dims, mask=None)`` — there the override would silently mis-bind
+    # (reduction_axes lands in ``mask`` and local moments come back
+    # unsynced).  Refuse loudly rather than train wrong.
+    import inspect
+
+    base_moments = getattr(tf.keras.layers.BatchNormalization, "_moments",
+                           None)
+    if base_moments is None:
+        # No hook point at all — the override below would never be called
+        # and moments would stay local.  Same silent-wrongness, same loud
+        # refusal.
+        raise RuntimeError(
+            "SyncBatchNormalization requires "
+            "BatchNormalization._moments(inputs, mask) (Keras 3); this "
+            "Keras has no _moments hook — cross-rank statistics cannot be "
+            "injected.")
+    params = [p for p in inspect.signature(base_moments).parameters
+              if p not in ("self",)]
+    if params != ["inputs", "mask"]:
+        raise RuntimeError(
+            "SyncBatchNormalization requires Keras 3 "
+            "(BatchNormalization._moments(inputs, mask)); this Keras's "
+            f"signature is _moments({', '.join(params)}) — the override "
+            "would silently return unsynchronized moments.")
+
     class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
         # No default layer name: Keras 3 rejects duplicate explicit names,
         # and models routinely hold many of these — auto-naming keeps each

@@ -152,6 +152,12 @@ class TcpMesh:
 
         deadline = time.monotonic() + timeout
         last: List[Optional[Exception]] = [None]
+        # Endpoints with a connect attempt still in flight: each 50 ms retry
+        # must NOT stack a fresh 5 s-timeout thread on a dead candidate the
+        # previous retry is still waiting out (threads/fds would accumulate
+        # linearly in retry count otherwise).
+        inflight: set = set()
+        inflight_lock = threading.Lock()
 
         def connect_all() -> List[socket.socket]:
             if len(endpoints) == 1:
@@ -171,21 +177,48 @@ class TcpMesh:
                 except OSError as e:
                     last[0] = e
                     results.put(None)
+                finally:
+                    with inflight_lock:
+                        inflight.discard((host, port))
 
+            spawned = 0
             for host, port in endpoints:
+                with inflight_lock:
+                    if (host, port) in inflight:
+                        continue
+                    inflight.add((host, port))
                 threading.Thread(target=conn, args=(host, port),
                                  daemon=True).start()
+                spawned += 1
             socks = []
-            for _ in endpoints:
+            received = 0
+            for _ in range(spawned):
                 try:
                     s = results.get(
                         timeout=max(0.1, deadline - time.monotonic()))
                 except queue_mod.Empty:
                     break
+                received += 1
                 if s is not None:
                     socks.append(s)
                 elif socks:
                     break  # have a candidate; don't wait for stragglers
+            if received < spawned:
+                # Straggler threads will still deposit sockets after we
+                # return — reap and close them so they don't leak until
+                # queue GC (ADVICE r3).
+                remaining = spawned - received
+
+                def reap():
+                    for _ in range(remaining):
+                        try:
+                            s = results.get(timeout=6.0)
+                        except queue_mod.Empty:
+                            return
+                        if s is not None:
+                            s.close()
+
+                threading.Thread(target=reap, daemon=True).start()
             return socks
 
         while time.monotonic() < deadline:

@@ -250,7 +250,7 @@ assert result.shape == (5,) and np.all(np.isfinite(result)), result
 # identical inputs -> identity
 assert np.allclose(result, a, atol=1e-5), result
 print("ODD_OK", rank, flush=True)
-""")
+""", timeout=240)
     for r, o in enumerate(out):
         assert f"ODD_OK {r}" in o
 
@@ -261,6 +261,6 @@ result = np.asarray(hvd.allreduce(v, op=hvd.Adasum, name="adasum.np2"))
 # identical-gradient behavior instead of a silent size-x sum
 assert np.allclose(result, 1.0), result
 print("NP2_OK", rank, flush=True)
-""")
+""", timeout=240)
     for r, o in enumerate(out):
         assert f"NP2_OK {r}" in o

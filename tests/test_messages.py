@@ -15,6 +15,9 @@ from horovod_tpu.core.messages import (
 )
 
 
+pytestmark = pytest.mark.smoke
+
+
 def test_request_roundtrip():
     req = Request(
         request_rank=3,

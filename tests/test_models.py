@@ -21,6 +21,9 @@ from horovod_tpu.models.training import (
 from horovod_tpu.parallel import MeshSpec, build_mesh, shard_batch
 
 
+pytestmark = pytest.mark.smoke
+
+
 def test_mlp_forward():
     model = MLP(features=(32,), num_classes=10)
     params = model.init(jax.random.PRNGKey(0), jnp.ones((2, 28, 28, 1)))

@@ -9,6 +9,9 @@ import pytest
 from horovod_tpu import _native
 
 
+pytestmark = pytest.mark.smoke
+
+
 @pytest.fixture(scope="module")
 def native_lib():
     lib = _native.lib()

@@ -28,6 +28,9 @@ from horovod_tpu.parallel.pipeline import stack_stage_params
 from horovod_tpu.parallel.sharding import shard_map_fn
 
 
+pytestmark = pytest.mark.smoke
+
+
 def test_mesh_shape_resolution():
     assert mesh_shape_for(MeshSpec(data=-1, model=2), 8) == (
         ("data", 4), ("pipe", 1), ("expert", 1), ("seq", 1), ("model", 2))

@@ -12,6 +12,9 @@ from horovod_tpu.runner.rendezvous import RendezvousServer
 from horovod_tpu.transport import HTTPStoreClient, MemoryStore, TcpMesh
 
 
+pytestmark = pytest.mark.smoke
+
+
 def run_ranks(size, fn, timeout=30):
     """Run fn(rank) on `size` threads; re-raise the first failure."""
     errs = []
