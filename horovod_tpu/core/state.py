@@ -424,6 +424,22 @@ class HorovodGlobalState:
                 # device state for numpy-only users).
                 ctx.initialize(self.topo)
             if ctx.ready:
+                if not getattr(tensor, "is_fully_addressable", True):
+                    # Replicated cross-process arrays (e.g. a previous
+                    # collective result fed straight back in) enter as
+                    # this rank's full local copy — the fuse jit is a
+                    # local computation.  A SHARDED global array has no
+                    # local equivalent: substituting the shard would
+                    # silently reduce shards instead of the value.
+                    if getattr(tensor.sharding, "is_fully_replicated",
+                               False):
+                        tensor = xla_backend._localize(tensor)
+                    else:
+                        raise HorovodInternalError(
+                            "a non-replicated multi-process global array "
+                            "was passed to an eager collective; gather or "
+                            "reshard it first (eager ops operate on each "
+                            "rank's local value).")
                 return tensor, xla_backend.XLA_DEVICE_ID
         return np.asarray(tensor), -1
 

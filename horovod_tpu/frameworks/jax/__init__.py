@@ -61,5 +61,9 @@ from .optimizer import (  # noqa: F401
     DistributedOptimizer,
     distributed_value_and_grad,
 )
+from .wfbp import (  # noqa: F401
+    OverlappedTrainStep,
+    make_overlapped_train_step,
+)
 from .sync_batch_norm import SyncBatchNorm, SyncBatchNormalization  # noqa: F401
 from ... import elastic  # noqa: F401  (hvd.elastic.run / hvd.elastic.JaxState)

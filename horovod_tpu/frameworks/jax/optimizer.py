@@ -23,8 +23,9 @@ from typing import Any, NamedTuple, Optional
 
 import numpy as np
 
-from . import ops
+from . import ops, wfbp
 from .compression import Compression
+from ...common.exceptions import HorovodInternalError
 
 try:
     import optax
@@ -36,6 +37,10 @@ class DistributedState(NamedTuple):
     inner_state: Any
     accumulated: Any        # grad accumulator pytree (or None leaves)
     counter: int
+    # overlap mode only: identifies this state's in-flight microbatch
+    # window in the factory's host-side table (handles are process-local
+    # and cannot live in a checkpointable pytree).  -1 = no open window.
+    window: int = -1
 
 
 def _leaf_names(tree) -> list:
@@ -72,11 +77,6 @@ def _allreduce_tree_per_leaf(grads, op, compression, prescale_factor,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-# Compiled flatten/unflatten per (shapes, dtypes) signature — steady-state
-# training reuses one entry forever.
-_tree_fuse_cache: dict = {}
-
-
 def _allreduce_tree(grads, op, compression, prescale_factor,
                     postscale_factor, name_prefix="grad"):
     """Cross-rank allreduce of a gradient pytree.
@@ -91,62 +91,18 @@ def _allreduce_tree(grads, op, compression, prescale_factor,
     compiled-collective cache perfectly warm (a dynamic composition would
     recompile whenever negotiation timing re-partitioned the queue) and
     reduces per-step dispatch + negotiation to O(dtypes) instead of
-    O(leaves).
+    O(leaves).  Enqueue/wait mechanics live in :mod:`.wfbp` so the
+    overlapped (microbatch-pipelined) mode shares them.
 
     Adasum falls back to per-leaf enqueue: its operator is per-tensor.
     """
-    import jax
-    import jax.numpy as jnp
-
     if op == ops.Adasum:
         return _allreduce_tree_per_leaf(grads, op, compression,
                                         prescale_factor, postscale_factor,
                                         name_prefix)
-
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
-    sig = tuple((tuple(l.shape), jnp.asarray(l).dtype.name) for l in leaves)
-    cached = _tree_fuse_cache.get(sig)
-    if cached is None:
-        # Group leaf indices by dtype, in first-seen order.
-        groups: dict = {}
-        for i, (_, dt) in enumerate(sig):
-            groups.setdefault(dt, []).append(i)
-        groups = list(groups.items())
-
-        def flatten(leaves_in):
-            return tuple(
-                jnp.concatenate([leaves_in[i].ravel() for i in idxs])
-                if len(idxs) > 1 else leaves_in[idxs[0]].ravel()
-                for _, idxs in groups)
-
-        def unflatten(bufs, leaves_in):
-            outs = list(leaves_in)  # placeholders, right treedef slots
-            for buf, (_, idxs) in zip(bufs, groups):
-                off = 0
-                for i in idxs:
-                    shape = sig[i][0]
-                    n = int(np.prod(shape)) if shape else 1
-                    outs[i] = buf[off:off + n].reshape(shape)
-                    off += n
-            return tuple(outs)
-
-        cached = (groups, jax.jit(flatten), jax.jit(unflatten))
-        _tree_fuse_cache[sig] = cached
-    groups, flatten, unflatten = cached
-
-    bufs = flatten(leaves)
-    handles, ctxs = [], []
-    for buf, (dt, idxs) in zip(bufs, groups):
-        comp, cctx = compression.compress(buf)
-        ctxs.append(cctx)
-        handles.append(ops.allreduce_async(
-            comp, name=f"{name_prefix}.fused.{dt}.{buf.size}", op=op,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor))
-    reduced = tuple(compression.decompress(ops.synchronize(h), c)
-                    for h, c in zip(handles, ctxs))
-    out = unflatten(reduced, leaves)
-    return jax.tree_util.tree_unflatten(treedef, out)
+    return wfbp.wait_tree(wfbp.enqueue_tree_fused(
+        grads, op, compression, prescale_factor, postscale_factor,
+        name_prefix))
 
 
 def DistributedOptimizer(tx, op: Optional[str] = None,
@@ -154,7 +110,8 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = True,
                          prescale_factor: float = 1.0,
-                         postscale_factor: float = 1.0):
+                         postscale_factor: float = 1.0,
+                         overlap: bool = False):
     """Wrap an optax transformation with cross-rank gradient allreduce.
 
     With ``backward_passes_per_step=N`` gradients accumulate locally and the
@@ -162,6 +119,19 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
     return zero updates (apply them unconditionally — they are no-ops on
     off steps), mirroring ``optax.MultiSteps`` and the reference's local
     gradient aggregation.
+
+    ``overlap=True`` (requires ``backward_passes_per_step >= 2``) switches
+    local aggregation to the WFBP schedule (reference
+    ``torch/optimizer.py:103-149``): each microbatch's fused gradients are
+    **enqueued the moment its backward returns** and reduced by the
+    background runtime while subsequent microbatches compute; the flush
+    step waits on all of them and averages.  Communicates every backward
+    pass (K× the bytes of accumulate-then-reduce — the same trade the
+    reference's WFBP makes vs its own local aggregation) in exchange for
+    hiding comm under compute.  Results are bit-identical to the
+    non-overlapped path by linearity of allreduce.  For the single-program
+    TPU regime prefer :func:`make_overlapped_train_step`, which overlaps
+    inside one compiled step (see :mod:`.wfbp`).
     """
     if optax is None:  # pragma: no cover
         raise ImportError("optax is required for DistributedOptimizer")
@@ -175,7 +145,15 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
                 "backward_passes_per_step > 1 is not supported with "
                 "op=Adasum (the delta-space optimizer communicates whole "
                 "optimizer steps; wrap tx in optax.MultiSteps instead)")
+        if overlap:
+            raise ValueError("overlap=True is not supported with op=Adasum")
         return DistributedAdasumOptimizer(tx, compression=compression)
+    if overlap and backward_passes_per_step < 2:
+        raise ValueError(
+            "overlap=True needs backward_passes_per_step >= 2 (there is no "
+            "later microbatch to overlap with); for single-backward steps "
+            "use make_overlapped_train_step, which overlaps comm with "
+            "backward inside one compiled program")
     n_accum = backward_passes_per_step
 
     # Every pure piece of the update runs under jit (compiled lazily, once
@@ -205,9 +183,70 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
         return DistributedState(inner_state=tx.init(params),
                                 accumulated=acc, counter=0)
 
+    # Overlap mode: in-flight microbatch windows, keyed by the window id
+    # carried IN the optimizer state (PendingTree handles are
+    # process-local and cannot ride a checkpointable pytree).  Keying by
+    # state — not a bare factory-scoped list — keeps two train states
+    # sharing one DistributedOptimizer from cross-mixing windows, and
+    # turns a restored/replayed mid-window state into a loud error
+    # instead of silently wrong gradients.
+    _windows: dict = {}
+    _window_seq = [0]
+
     def update(grads, state: DistributedState, params=None):
         import jax
         import jax.numpy as jnp
+
+        if overlap and n_accum > 1:
+            count = state.counter + 1
+            window = state.window
+            if count == 1 and ops.initialized():
+                _window_seq[0] += 1
+                window = _window_seq[0]
+                _windows[window] = []
+            if window in _windows:
+                pending = _windows[window]
+                if len(pending) != count - 1:
+                    del _windows[window]
+                    raise HorovodInternalError(
+                        f"overlap window desync: state says microbatch "
+                        f"{count}/{n_accum} but {len(pending)} enqueues "
+                        "are in flight — was this optimizer state "
+                        "checkpointed/restored mid-window?  Restore only "
+                        "at window boundaries (counter == 0) with "
+                        "overlap=True.")
+                # WFBP: enqueue this microbatch NOW; the background runtime
+                # negotiates + reduces it under the next microbatch's
+                # backward.  Wait only at the flush.
+                pending.append(wfbp.enqueue_tree_fused(
+                    grads, op_name, compression, prescale_factor,
+                    postscale_factor, name_prefix=f"grad.mb{count - 1}"))
+                if count < n_accum:
+                    zeros = _jitted(
+                        "zeros",
+                        lambda g: jax.tree_util.tree_map(jnp.zeros_like, g)
+                    )(grads)
+                    return zeros, DistributedState(
+                        state.inner_state, state.accumulated, count, window)
+                trees = [wfbp.wait_tree(p) for p in pending]
+                del _windows[window]
+                scale = 1.0 / n_accum if average_aggregated_gradients \
+                    else 1.0
+                grads = _jitted(
+                    "combine",
+                    lambda *ts: jax.tree_util.tree_map(
+                        lambda *xs: sum(xs) * scale, *ts))(*trees)
+                updates, inner = _jitted("update", tx.update)(
+                    grads, state.inner_state, params)
+                return updates, DistributedState(inner, state.accumulated,
+                                                 0, -1)
+            if count > 1 and state.window != -1:
+                raise HorovodInternalError(
+                    "overlap window lost: this optimizer state references "
+                    f"in-flight window {state.window} unknown to this "
+                    "process — overlap=True state cannot be restored or "
+                    "moved mid-window (counter != 0).")
+            # runtime down for this window: plain local aggregation below
 
         if n_accum > 1:
             count = state.counter + 1

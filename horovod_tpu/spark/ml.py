@@ -1,0 +1,440 @@
+"""Spark ML pipeline integration: real ``pyspark.ml.Estimator``/``Model``
+subclasses with ``Params``.
+
+Role of the reference's estimator layer (``spark/keras/estimator.py:564``
+``KerasEstimator(Estimator, EstimatorParams, ...)`` and
+``spark/common/params.py:1-374`` — getter/setter ``Param``s, Pipeline /
+CrossValidator compatibility, ML persistence).  The portable training
+machinery lives in :mod:`horovod_tpu.spark.keras` / :mod:`.torch` (plain
+classes, no pyspark needed); THIS module is the pyspark.ml veneer over
+them, importable only where pyspark exists:
+
+    from horovod_tpu.spark.ml import KerasEstimator
+    pipe = Pipeline(stages=[KerasEstimator(model=m, optimizer=opt,
+                                           loss="mse")])
+    model = pipe.fit(train_df)
+    model.transform(test_df)   # appends the prediction column
+
+Persistence: custom ``MLWriter``/``MLReader`` pairs (the reference's
+``HorovodParamsWriter`` role, ``spark/common/serialization.py``) — params
+ride DefaultParams JSON, the fitted network rides a sidecar blob.
+Verified by the real-pyspark lane (``tests/test_real_integrations.py``);
+everything here raises ImportError cleanly when pyspark is absent.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from ..common.pickling import dumps, loads
+
+try:  # pragma: no cover - exercised only in the real-pyspark lane
+    from pyspark import keyword_only
+    from pyspark.ml import Estimator, Model
+    from pyspark.ml.param import Param, Params, TypeConverters
+    from pyspark.ml.util import (
+        DefaultParamsReader,
+        DefaultParamsWriter,
+        MLReadable,
+        MLReader,
+        MLWritable,
+        MLWriter,
+    )
+
+    HAVE_PYSPARK = True
+except ImportError as _e:  # pragma: no cover
+    HAVE_PYSPARK = False
+    _pyspark_err = _e
+
+    def __getattr__(name):
+        raise ImportError(
+            f"horovod_tpu.spark.ml requires pyspark (failed: {_pyspark_err}); "
+            "the portable estimators live in horovod_tpu.spark.keras / "
+            ".torch")
+
+
+if HAVE_PYSPARK:  # pragma: no cover - real-pyspark lane only
+
+    class _HorovodParams(Params):
+        """Shared Param definitions (reference ``EstimatorParams``,
+        ``spark/common/params.py:27-374``)."""
+
+        feature_cols = Param(Params._dummy(), "feature_cols",
+                             "feature column names",
+                             typeConverter=TypeConverters.toListString)
+        label_cols = Param(Params._dummy(), "label_cols",
+                           "label column names",
+                           typeConverter=TypeConverters.toListString)
+        batch_size = Param(Params._dummy(), "batch_size",
+                           "per-rank minibatch size",
+                           typeConverter=TypeConverters.toInt)
+        epochs = Param(Params._dummy(), "epochs", "training epochs",
+                       typeConverter=TypeConverters.toInt)
+        num_proc = Param(Params._dummy(), "num_proc",
+                         "number of training processes (ranks)",
+                         typeConverter=TypeConverters.toInt)
+        validation = Param(Params._dummy(), "validation",
+                           "fraction of rows held out for validation",
+                           typeConverter=TypeConverters.toFloat)
+        verbose = Param(Params._dummy(), "verbose", "training verbosity",
+                        typeConverter=TypeConverters.toInt)
+        output_col = Param(Params._dummy(), "output_col",
+                           "prediction output column",
+                           typeConverter=TypeConverters.toString)
+
+        def setFeatureCols(self, value):
+            return self._set(feature_cols=value)
+
+        def getFeatureCols(self):
+            return self.getOrDefault(self.feature_cols)
+
+        def setLabelCols(self, value):
+            return self._set(label_cols=value)
+
+        def getLabelCols(self):
+            return self.getOrDefault(self.label_cols)
+
+        def setBatchSize(self, value):
+            return self._set(batch_size=value)
+
+        def getBatchSize(self):
+            return self.getOrDefault(self.batch_size)
+
+        def setEpochs(self, value):
+            return self._set(epochs=value)
+
+        def getEpochs(self):
+            return self.getOrDefault(self.epochs)
+
+        def setNumProc(self, value):
+            return self._set(num_proc=value)
+
+        def getNumProc(self):
+            return self.getOrDefault(self.num_proc)
+
+        def setValidation(self, value):
+            return self._set(validation=value)
+
+        def getValidation(self):
+            return self.getOrDefault(self.validation)
+
+        def setVerbose(self, value):
+            return self._set(verbose=value)
+
+        def getVerbose(self):
+            return self.getOrDefault(self.verbose)
+
+        def setOutputCol(self, value):
+            return self._set(output_col=value)
+
+        def getOutputCol(self):
+            return self.getOrDefault(self.output_col)
+
+    class _BlobWriter(MLWriter):
+        """DefaultParams JSON for the Params + a pickled sidecar for the
+        non-Param payload (model architecture / weights / store config)."""
+
+        def __init__(self, instance):
+            super().__init__()
+            self._instance = instance
+
+        def saveImpl(self, path):
+            DefaultParamsWriter.saveMetadata(
+                self._instance, path, self.sc,
+                extraMetadata={"hvd_class":
+                               type(self._instance).__name__})
+            blob = dumps(self._instance._payload())
+            # Write through the JVM-side filesystem API so object stores
+            # (s3/hdfs/dbfs) work, not only the local FS.
+            self.sc.parallelize([blob], 1).map(bytearray).saveAsPickleFile(
+                os.path.join(path, "horovod_blob"))
+
+    class _BlobReader(MLReader):
+        def __init__(self, cls):
+            super().__init__()
+            self._cls = cls
+
+        def load(self, path):
+            metadata = DefaultParamsReader.loadMetadata(path, self.sc)
+            blob = bytes(self.sc.pickleFile(
+                os.path.join(path, "horovod_blob")).collect()[0])
+            inst = self._cls._from_payload(loads(blob))
+            inst._resetUid(metadata["uid"])
+            DefaultParamsReader.getAndSetParams(inst, metadata)
+            return inst
+
+    class _BlobPersistence(MLWritable, MLReadable):
+        def write(self):
+            return _BlobWriter(self)
+
+        @classmethod
+        def read(cls):
+            return _BlobReader(cls)
+
+    # -- Keras ----------------------------------------------------------
+
+    class KerasEstimator(Estimator, _HorovodParams, _BlobPersistence):
+        """``pyspark.ml.Estimator`` flavor of
+        :class:`horovod_tpu.spark.keras.KerasEstimator`."""
+
+        @keyword_only
+        def __init__(self, *, model=None, optimizer=None, loss=None,
+                     metrics=None, store=None,
+                     feature_cols=("features",), label_cols=("label",),
+                     batch_size=32, epochs=1, num_proc=None,
+                     validation=0.0, verbose=0, output_col="prediction"):
+            super().__init__()
+            self.model = model
+            self.optimizer = optimizer
+            self.loss = loss
+            self.metrics = metrics
+            self.store = store
+            self._setDefault(feature_cols=["features"],
+                             label_cols=["label"], batch_size=32, epochs=1,
+                             num_proc=None, validation=0.0, verbose=0,
+                             output_col="prediction")
+            kwargs = self._input_kwargs
+            for k in ("model", "optimizer", "loss", "metrics", "store"):
+                kwargs.pop(k, None)
+            if kwargs.get("num_proc") is None:
+                kwargs.pop("num_proc", None)
+            kwargs["feature_cols"] = list(kwargs.get("feature_cols",
+                                                     ["features"]))
+            kwargs["label_cols"] = list(kwargs.get("label_cols", ["label"]))
+            self._set(**kwargs)
+
+        def _payload(self):
+            import keras
+
+            return {"model_json": self.model.to_json() if self.model
+                    else None,
+                    "optimizer": (keras.optimizers.serialize(self.optimizer)
+                                  if self.optimizer is not None else None),
+                    "store": dumps(self.store)
+                    if self.store is not None else None,
+                    "loss": self.loss, "metrics": self.metrics}
+
+        @classmethod
+        def _from_payload(cls, payload):
+            inst = cls()
+            if payload.get("model_json") or payload.get("optimizer"):
+                import keras
+
+                if payload.get("model_json"):
+                    inst.model = keras.models.model_from_json(
+                        payload["model_json"])
+                if payload.get("optimizer"):
+                    inst.optimizer = keras.optimizers.deserialize(
+                        payload["optimizer"])
+            if payload.get("store"):
+                inst.store = loads(payload["store"])
+            inst.loss = payload.get("loss")
+            inst.metrics = payload.get("metrics")
+            return inst
+
+        def _fit(self, dataset):
+            from .keras import KerasEstimator as PlainEstimator
+
+            plain = PlainEstimator(
+                model=self.model, optimizer=self.optimizer, loss=self.loss,
+                metrics=self.metrics,
+                feature_cols=list(self.getFeatureCols()),
+                label_cols=list(self.getLabelCols()),
+                batch_size=self.getBatchSize(), epochs=self.getEpochs(),
+                num_proc=(self.getOrDefault(self.num_proc)
+                          if self.isDefined(self.num_proc) else None),
+                store=self.store,
+                validation=self.getValidation(),
+                verbose=self.getVerbose(),
+                sc=dataset.sparkSession.sparkContext)
+            fitted = plain.fit(dataset)
+            model = KerasModel(output_col=self.getOutputCol())
+            model._fitted = fitted
+            model._set(feature_cols=list(self.getFeatureCols()))
+            return model
+
+    class KerasModel(Model, _HorovodParams, _BlobPersistence):
+        """Fitted transformer: ``transform(df)`` appends the prediction
+        column via a per-executor-cached udf (reference
+        ``KerasModel._transform``)."""
+
+        @keyword_only
+        def __init__(self, *, output_col="prediction"):
+            super().__init__()
+            self._fitted = None  # horovod_tpu.spark.keras.KerasModel
+            self._setDefault(output_col="prediction",
+                             feature_cols=["features"])
+            self._set(**self._input_kwargs)
+
+        def _payload(self):
+            return {"model_blob": self._fitted.model_blob,
+                    "weights": self._fitted.weights,
+                    "feature_cols": self._fitted.feature_cols}
+
+        @classmethod
+        def _from_payload(cls, payload):
+            from .keras import KerasModel as PlainModel
+
+            inst = cls()
+            inst._fitted = PlainModel(payload["model_blob"],
+                                      payload["weights"],
+                                      payload["feature_cols"])
+            return inst
+
+        def _transform(self, dataset):
+            from pyspark.sql.functions import col, udf
+            from pyspark.sql.types import ArrayType, DoubleType
+
+            sc = dataset.sparkSession.sparkContext
+            blob = sc.broadcast(dumps(self._payload()))
+            fcols = list(self.getFeatureCols())
+            cache: dict = {}
+
+            def _predict(*features):
+                import numpy as np
+
+                if "m" not in cache:
+                    from .keras import KerasModel as PlainModel
+
+                    d = loads(blob.value)
+                    cache["m"] = PlainModel(d["model_blob"], d["weights"],
+                                            d["feature_cols"])
+                row = [f.toArray() if hasattr(f, "toArray") else f
+                       for f in features]
+                x = np.concatenate([np.atleast_1d(
+                    np.asarray(r, dtype=np.float64)) for r in row])
+                pred = cache["m"].predict(x[None, :])[0]
+                return [float(v) for v in np.atleast_1d(pred)]
+
+            fn = udf(_predict, ArrayType(DoubleType()))
+            return dataset.withColumn(
+                self.getOutputCol(), fn(*[col(c) for c in fcols]))
+
+    # -- Torch ----------------------------------------------------------
+
+    class TorchEstimator(Estimator, _HorovodParams, _BlobPersistence):
+        """``pyspark.ml.Estimator`` flavor of
+        :class:`horovod_tpu.spark.torch.TorchEstimator`."""
+
+        @keyword_only
+        def __init__(self, *, model=None, optimizer_factory=None, loss=None,
+                     store=None, feature_cols=("features",),
+                     label_cols=("label",), batch_size=32, epochs=1,
+                     num_proc=None, validation=0.0, verbose=0,
+                     output_col="prediction"):
+            super().__init__()
+            self.model = model
+            self.optimizer_factory = optimizer_factory
+            self.loss = loss
+            self.store = store
+            self._setDefault(feature_cols=["features"],
+                             label_cols=["label"], batch_size=32, epochs=1,
+                             num_proc=None, validation=0.0, verbose=0,
+                             output_col="prediction")
+            kwargs = self._input_kwargs
+            for k in ("model", "optimizer_factory", "loss", "store"):
+                kwargs.pop(k, None)
+            if kwargs.get("num_proc") is None:
+                kwargs.pop("num_proc", None)
+            kwargs["feature_cols"] = list(kwargs.get("feature_cols",
+                                                     ["features"]))
+            kwargs["label_cols"] = list(kwargs.get("label_cols", ["label"]))
+            self._set(**kwargs)
+
+        def _payload(self):
+            return {"model": dumps(self.model) if self.model is not None
+                    else None,
+                    "optimizer_factory": dumps(self.optimizer_factory)
+                    if self.optimizer_factory is not None else None,
+                    "store": dumps(self.store)
+                    if self.store is not None else None,
+                    "loss": self.loss}
+
+        @classmethod
+        def _from_payload(cls, payload):
+            inst = cls()
+            if payload.get("model") is not None:
+                inst.model = loads(payload["model"])
+            if payload.get("optimizer_factory"):
+                inst.optimizer_factory = loads(payload["optimizer_factory"])
+            if payload.get("store"):
+                inst.store = loads(payload["store"])
+            inst.loss = payload.get("loss")
+            return inst
+
+        def _fit(self, dataset):
+            from .torch import TorchEstimator as PlainEstimator
+
+            plain = PlainEstimator(
+                model=self.model,
+                optimizer_factory=self.optimizer_factory, loss=self.loss,
+                feature_cols=list(self.getFeatureCols()),
+                label_cols=list(self.getLabelCols()),
+                batch_size=self.getBatchSize(), epochs=self.getEpochs(),
+                num_proc=(self.getOrDefault(self.num_proc)
+                          if self.isDefined(self.num_proc) else None),
+                store=self.store, validation=self.getValidation(),
+                sc=dataset.sparkSession.sparkContext)
+            fitted = plain.fit(dataset)
+            model = TorchModel(output_col=self.getOutputCol())
+            model._fitted = fitted
+            model._set(feature_cols=list(self.getFeatureCols()))
+            return model
+
+    class TorchModel(Model, _HorovodParams, _BlobPersistence):
+        @keyword_only
+        def __init__(self, *, output_col="prediction"):
+            super().__init__()
+            self._fitted = None  # horovod_tpu.spark.torch.TorchModel
+            self._setDefault(output_col="prediction",
+                             feature_cols=["features"])
+            self._set(**self._input_kwargs)
+
+        def _payload(self):
+            return {"model_blob": self._fitted.model_blob,
+                    "state_dict": self._fitted.state_dict,
+                    "feature_cols": self._fitted.feature_cols}
+
+        @classmethod
+        def _from_payload(cls, payload):
+            from .torch import TorchModel as PlainModel
+
+            inst = cls()
+            inst._fitted = PlainModel(payload["model_blob"],
+                                      payload["state_dict"],
+                                      payload["feature_cols"])
+            return inst
+
+        def _transform(self, dataset):
+            from pyspark.sql.functions import col, udf
+            from pyspark.sql.types import ArrayType, DoubleType
+
+            sc = dataset.sparkSession.sparkContext
+            blob = sc.broadcast(dumps(self._payload()))
+            fcols = list(self.getFeatureCols())
+            cache: dict = {}
+
+            def _predict(*features):
+                import numpy as np
+
+                if "m" not in cache:
+                    from .torch import TorchModel as PlainModel
+
+                    d = loads(blob.value)
+                    cache["m"] = PlainModel(d["model_blob"],
+                                            d["state_dict"],
+                                            d["feature_cols"])
+                row = [f.toArray() if hasattr(f, "toArray") else f
+                       for f in features]
+                x = np.concatenate([np.atleast_1d(
+                    np.asarray(r, dtype=np.float64)) for r in row])
+                pred = cache["m"].predict(x[None, :])[0]
+                return [float(v) for v in np.atleast_1d(pred)]
+
+            fn = udf(_predict, ArrayType(DoubleType()))
+            return dataset.withColumn(
+                self.getOutputCol(), fn(*[col(c) for c in fcols]))
+
+    __all__ = ["KerasEstimator", "KerasModel", "TorchEstimator",
+               "TorchModel", "HAVE_PYSPARK"]

@@ -102,6 +102,55 @@ def test_real_pyspark_estimator_store_plane(tmp_path):
         spark.stop()
 
 
+def test_real_pyspark_ml_pipeline(tmp_path):
+    """The pyspark.ml veneer (VERDICT r3 #6): KerasEstimator inside a real
+    ``Pipeline``, params get/set, ``transform`` appending predictions, and
+    ML persistence round-trip."""
+    pyspark = pytest.importorskip("pyspark", reason="real-pyspark lane only")
+    keras = pytest.importorskip("keras")
+    from pyspark.ml import Pipeline
+    from pyspark.sql import SparkSession
+
+    from horovod_tpu.spark.ml import KerasEstimator, KerasModel
+
+    spark = SparkSession.builder.master("local[2]").getOrCreate()
+    try:
+        rows = [([float(i) / 10.0, float(i % 3)], float(i % 2))
+                for i in range(24)]
+        df = spark.createDataFrame(rows, ["features", "label"])
+
+        net = keras.Sequential([keras.layers.Input(shape=(2,)),
+                                keras.layers.Dense(4, activation="tanh"),
+                                keras.layers.Dense(1)])
+        est = KerasEstimator(model=net,
+                             optimizer=keras.optimizers.SGD(0.05),
+                             loss="mse", batch_size=8, epochs=1,
+                             num_proc=2)
+        # Params surface (CrossValidator compatibility)
+        assert est.getBatchSize() == 8
+        est.setEpochs(2)
+        assert est.getEpochs() == 2
+        assert est.copy().getEpochs() == 2
+
+        pipe = Pipeline(stages=[est])
+        model = pipe.fit(df)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        preds = out.select("prediction").collect()
+        assert len(preds) == 24 and len(preds[0][0]) == 1
+
+        # ML persistence round-trip
+        path = str(tmp_path / "hvd_keras_model")
+        fitted = model.stages[0]
+        fitted.write().overwrite().save(path)
+        loaded = KerasModel.read().load(path)
+        out2 = loaded.transform(df).select("prediction").collect()
+        assert np.allclose([p[0] for p in preds], [p[0] for p in out2],
+                           atol=1e-6)
+    finally:
+        spark.stop()
+
+
 def test_real_mxnet_binding_smoke():
     mx = pytest.importorskip("mxnet", reason="real-mxnet lane only")
 

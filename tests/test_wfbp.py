@@ -1,0 +1,238 @@
+"""WFBP overlap tests: microbatch-pipelined enqueue + in-program step.
+
+Reference analog: WFBP hook scheduling in ``torch/optimizer.py:103-149``,
+verified there by ``test/parallel/test_torch.py`` gradient-equivalence
+cases.  Here: (a) overlap=True is bit-equivalent to accumulate-then-reduce
+(linearity), (b) the compiled overlapped step trains identically to
+single-process training on the concatenated batch (sync-DP equivalence),
+(c) misuse raises.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from .helpers import run_distributed
+
+
+def _xla_env() -> dict:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return {
+        "HOROVOD_DATA_PLANE": "xla",
+        "HOROVOD_JAX_COORDINATOR": f"127.0.0.1:{port}",
+    }
+
+
+def test_overlap_requires_multiple_backward_passes():
+    import optax
+
+    from horovod_tpu.frameworks.jax.optimizer import DistributedOptimizer
+
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        DistributedOptimizer(optax.sgd(0.1), overlap=True)
+    with pytest.raises(ValueError, match="Adasum"):
+        DistributedOptimizer(optax.sgd(0.1), op="adasum",
+                             backward_passes_per_step=2, overlap=True)
+
+
+def test_overlap_matches_accumulate_two_ranks():
+    """overlap=True and the plain bpps path produce identical updates:
+    allreduce is linear, so reduce-every-microbatch == reduce-the-sum."""
+    out = run_distributed(2, """
+import jax
+import jax.numpy as jnp
+import optax
+from horovod_tpu.frameworks.jax.optimizer import DistributedOptimizer
+
+params = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+          "b": jnp.ones(3, jnp.float32)}
+# rank-dependent microbatch gradients
+def g(mb):
+    return {"w": jnp.full((2, 3), float(rank + 1 + mb)),
+            "b": jnp.full(3, float(10 * rank + mb))}
+
+results = {}
+for overlap in (False, True):
+    tx = optax.sgd(0.1, momentum=0.9)
+    dopt = DistributedOptimizer(tx, backward_passes_per_step=3,
+                                overlap=overlap)
+    st = dopt.init(params)
+    p = params
+    for step in range(2):          # two full accumulation windows
+        for mb in range(3):
+            upd, st = dopt.update(g(mb), st, p)
+            p = optax.apply_updates(p, upd)
+    results[overlap] = p
+
+for k in results[False]:
+    a = np.asarray(results[False][k])
+    b = np.asarray(results[True][k])
+    assert np.allclose(a, b, atol=1e-6), (k, a, b)
+print("OVERLAP_EQ_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        assert f"OVERLAP_EQ_OK {r}" in o
+
+
+def test_overlapped_step_single_process():
+    """np=1 smoke: the compiled overlapped step runs, loss decreases, and
+    matches plain optax exactly (size-1 mesh, allreduce is identity)."""
+    out = run_distributed(1, """
+import jax
+import jax.numpy as jnp
+import optax
+from horovod_tpu.frameworks.jax.wfbp import make_overlapped_train_step
+
+def loss_fn(params, batch):
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+rng = np.random.RandomState(0)
+params = {"w": jnp.asarray(rng.randn(4, 2), jnp.float32)}
+tx = optax.sgd(0.05)
+batches = [{"x": jnp.asarray(rng.randn(8, 4), jnp.float32),
+            "y": jnp.asarray(rng.randn(8, 2), jnp.float32)}
+           for _ in range(5)]
+
+step = make_overlapped_train_step(loss_fn, tx)
+p, s = step.init(params, tx.init(params))
+losses = []
+for b in batches:
+    p, s, loss = step(p, s, b)
+    losses.append(float(np.asarray(loss)))
+assert losses[-1] < losses[0], losses
+
+# exact match vs plain optax
+p2, s2 = params, tx.init(params)
+fn = jax.jit(lambda p, s, b: (lambda l, g: (optax.apply_updates(
+    p, tx.update(g, s, p)[0]), tx.update(g, s, p)[1], l))(
+    *jax.value_and_grad(loss_fn)(p, b)))
+for b in batches:
+    p2, s2, _ = fn(p2, s2, b)
+got = np.asarray(step.fetch(p)["w"])
+exp = np.asarray(p2["w"])
+assert np.allclose(got, exp, atol=1e-6), (got, exp)
+print("WFBP_STEP_OK", rank, flush=True)
+""", timeout=240)
+    assert "WFBP_STEP_OK 0" in out[0]
+
+
+def test_overlapped_step_has_aux():
+    """Aux state (flax batch_stats shape) threads through the compiled
+    step and matches a hand-rolled update."""
+    out = run_distributed(1, """
+import jax
+import jax.numpy as jnp
+import optax
+from horovod_tpu.frameworks.jax.wfbp import make_overlapped_train_step
+
+def loss_fn(p, aux, b):
+    pred = b["x"] @ p["w"]
+    new_aux = {"ema": 0.9 * aux["ema"] + 0.1 * jnp.mean(pred)}
+    return jnp.mean((pred - b["y"]) ** 2), new_aux
+
+rng = np.random.RandomState(1)
+params = {"w": jnp.asarray(rng.randn(3, 2), jnp.float32)}
+aux = {"ema": jnp.zeros(())}
+tx = optax.sgd(0.1)
+step = make_overlapped_train_step(loss_fn, tx, has_aux=True)
+p, s, a = step.init(params, tx.init(params), aux)
+b = {"x": jnp.asarray(rng.randn(4, 3), jnp.float32),
+     "y": jnp.asarray(rng.randn(4, 2), jnp.float32)}
+for _ in range(3):
+    p, s, a, loss = step(p, s, b, a)
+
+# manual reference
+p2, a2, s2 = params, aux, tx.init(params)
+for _ in range(3):
+    (l, a2), g = jax.value_and_grad(loss_fn, has_aux=True)(p2, a2, b)
+    upd, s2 = tx.update(g, s2, p2)
+    p2 = optax.apply_updates(p2, upd)
+assert np.allclose(np.asarray(step.fetch(p)["w"]), np.asarray(p2["w"]),
+                   atol=1e-6)
+assert np.allclose(np.asarray(step.fetch(a)["ema"]),
+                   np.asarray(a2["ema"]), atol=1e-6)
+print("WFBP_AUX_OK", rank, flush=True)
+""", timeout=240)
+    assert "WFBP_AUX_OK 0" in out[0]
+
+
+def test_overlapped_step_matches_big_batch_two_ranks():
+    """Sync-DP equivalence: two ranks on half-batches through the
+    overlapped step == one process on the full batch.  The in-program
+    allreduce must therefore compute the exact global-mean gradient."""
+    out = run_distributed(2, """
+import jax
+import jax.numpy as jnp
+import optax
+from horovod_tpu.backend.xla import context
+from horovod_tpu.frameworks.jax.wfbp import make_overlapped_train_step
+assert context().ready, "XLA data plane required"
+
+def loss_fn(params, batch):
+    pred = jnp.tanh(batch["x"] @ params["w1"]) @ params["w2"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+rng = np.random.RandomState(7)
+params = {"w1": jnp.asarray(rng.randn(4, 8) * 0.3, jnp.float32),
+          "w2": jnp.asarray(rng.randn(8, 2) * 0.3, jnp.float32)}
+X = rng.randn(4, 6, 4).astype(np.float32)   # [steps, global_batch, d]
+Y = rng.randn(4, 6, 2).astype(np.float32)
+
+tx = optax.sgd(0.1, momentum=0.9)
+step = make_overlapped_train_step(loss_fn, tx)
+p, s = step.init(params, tx.init(params))
+lo = rank * 3
+for i in range(4):
+    b = {"x": jnp.asarray(X[i, lo:lo + 3]), "y": jnp.asarray(Y[i, lo:lo + 3])}
+    p, s, loss = step(p, s, b)
+got = {k: np.asarray(v) for k, v in step.fetch(p).items()}
+
+# single-process reference on the full batch
+p2, s2 = params, tx.init(params)
+vg = jax.jit(jax.value_and_grad(loss_fn))
+for i in range(4):
+    _, g = vg(p2, {"x": jnp.asarray(X[i]), "y": jnp.asarray(Y[i])})
+    upd, s2 = tx.update(g, s2, p2)
+    p2 = optax.apply_updates(p2, upd)
+for k in got:
+    exp = np.asarray(p2[k])
+    assert np.allclose(got[k], exp, atol=1e-5), (k, got[k], exp)
+print("WFBP_DP_OK", rank, flush=True)
+""", timeout=300, extra_env=_xla_env())
+    for r, o in enumerate(out):
+        assert f"WFBP_DP_OK {r}" in o
+
+
+def test_overlapped_step_signature_divergence_raises():
+    """A rank tracing a different program shape must fail loudly up front
+    (the negotiation-plane signature check), not hang in the collective."""
+    out = run_distributed(2, """
+import jax.numpy as jnp
+import optax
+from horovod_tpu.backend.xla import context
+from horovod_tpu.frameworks.jax.wfbp import make_overlapped_train_step
+assert context().ready
+
+def loss_fn(params, batch):
+    return jnp.mean((batch["x"] @ params["w"]) ** 2)
+
+w_cols = 2 if rank == 0 else 3        # divergent param shapes
+params = {"w": jnp.ones((4, w_cols), jnp.float32)}
+tx = optax.sgd(0.1)
+step = make_overlapped_train_step(loss_fn, tx)
+p, s = step.init(params, tx.init(params))
+try:
+    step(p, s, {"x": jnp.ones((2, 4), jnp.float32)})
+except RuntimeError as e:
+    assert "diverged" in str(e), e
+    print("WFBP_SIG_OK", rank, flush=True)
+else:
+    print("WFBP_SIG_MISSED", rank, flush=True)
+""", timeout=300, extra_env=_xla_env())
+    for r, o in enumerate(out):
+        assert f"WFBP_SIG_OK {r}" in o
