@@ -144,7 +144,7 @@ def main() -> None:
                             wstate.batch_stats)
     wbatch = {"x": x, "y": y}
 
-    for _ in range(warmup):
+    for _ in range(max(1, warmup)):  # >=1: compile outside the timed loop
         wp, ws, wa, wloss = wstep(wp, ws, wbatch, wa)
     float(np.asarray(wloss))
     t0 = time.perf_counter()

@@ -11,9 +11,12 @@ that WERE measured, with every assumption explicit in the output:
   torus — a conservative fraction of the 1600 Gbit/s ICI spec);
 - controller hot-path cycle from the coordinator simulation
   (`benchmarks/results/controller_sim.json` p50);
-- per-dispatch host overhead of the np>1 eager chain: MEASURED at np=8 on
-  the virtual CPU mesh (`benchmarks/results/eager_np8_cpu.json`,
-  VERDICT r3 missing #6) — an upper bound (2-core host running 8 ranks);
+- per-dispatch host overhead of the np>1 eager chain: MEASURED on the
+  virtual CPU mesh (VERDICT r3 missing #6).  The np=2 artifact
+  (`benchmarks/results/eager_np2_cpu.json`, one rank per host core — the
+  closest proxy for process-per-chip) is the preferred input; the np=8
+  artifact is kept as a 4×-oversubscription stress point, not a model
+  input;
 - three planes:
   * **jit / SPMD**: XLA overlaps the psum with backward
     (exposed = max(0, t_comm − backward), backward ≈ 2/3 of step);

@@ -201,6 +201,19 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
             count = state.counter + 1
             window = state.window
             if count == 1 and ops.initialized():
+                # Evict abandoned windows (a mid-window exception or a
+                # discarded train state never flushes): drain their
+                # handles so neither the gradient pytrees nor the handle
+                # events leak.  A few concurrently-open windows is the
+                # legitimate maximum (one per live train state).
+                while len(_windows) > 3:
+                    stale = min(_windows)
+                    for rec in _windows.pop(stale):
+                        for h in rec.handles:
+                            try:
+                                ops.synchronize(h)
+                            except Exception:  # noqa: BLE001 — draining
+                                pass
                 _window_seq[0] += 1
                 window = _window_seq[0]
                 _windows[window] = []

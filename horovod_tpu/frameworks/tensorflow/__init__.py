@@ -539,6 +539,16 @@ def _allreduce_grads(grads, compression, op, prescale, postscale):
             continue
         if isinstance(g, tf.IndexedSlices):
             out[i] = allreduce(g, op=op, name=f"grad.{i}")
+        elif not g.shape.is_fully_defined():
+            # Dynamic-shaped gradients (e.g. w.r.t. a (None, d) input
+            # tensor) cannot ride the static split-back of the fused
+            # batch; the per-tensor path handles unknown shapes via
+            # set_shape.
+            comp, ctx = compression.compress(g)
+            red = allreduce(comp, op=op, name=f"grad.{i}",
+                            prescale_factor=prescale,
+                            postscale_factor=postscale)
+            out[i] = compression.decompress(red, ctx)
         else:
             dense.append(i)
     if dense:

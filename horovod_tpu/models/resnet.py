@@ -74,6 +74,12 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # BN statistics precision/algorithm levers (benchmarks/resnet_levers.py
+    # measures them; docs/perf_r4.md records the verdicts).  Defaults are
+    # the numerically safe flax behavior: fp32 reductions, one-pass
+    # E[x^2]-E[x]^2 variance.
+    bn_f32_stats: bool = True
+    bn_fast_variance: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -81,7 +87,9 @@ class ResNet(nn.Module):
                                  param_dtype=jnp.float32)
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
-                                 dtype=self.dtype, param_dtype=jnp.float32)
+                                 dtype=self.dtype, param_dtype=jnp.float32,
+                                 force_float32_reductions=self.bn_f32_stats,
+                                 use_fast_variance=self.bn_fast_variance)
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="conv_init")(x)

@@ -25,7 +25,6 @@ everything here raises ImportError cleanly when pyspark is absent.
 from __future__ import annotations
 
 import os
-from typing import Any
 
 from ..common.pickling import dumps, loads
 
@@ -172,6 +171,42 @@ if HAVE_PYSPARK:  # pragma: no cover - real-pyspark lane only
         def read(cls):
             return _BlobReader(cls)
 
+    def _transform_with(dataset, payload, loader, fcols, out_col):
+        """Shared transform: BATCHED executor-side prediction via
+        pandas_udf (one framework predict() per Arrow batch, not per row
+        — the reference's batched executor-prediction shape,
+        ``spark/keras/estimator.py`` transform path).  ``loader`` maps
+        the broadcast payload dict to a fitted plain model."""
+        from pyspark.sql.functions import col, pandas_udf
+        from pyspark.sql.types import ArrayType, DoubleType
+
+        sc = dataset.sparkSession.sparkContext
+        blob = sc.broadcast(dumps(payload))
+        cache: dict = {}
+
+        def _to_matrix(series):
+            import numpy as np
+
+            rows = [np.atleast_1d(np.asarray(
+                v.toArray() if hasattr(v, "toArray") else v,
+                dtype=np.float64)) for v in series]
+            return np.stack(rows)
+
+        @pandas_udf(ArrayType(DoubleType()))
+        def _predict(*cols_in):
+            import numpy as np
+            import pandas as pd
+
+            if "m" not in cache:
+                cache["m"] = loader(loads(blob.value))
+            x = np.concatenate([_to_matrix(c) for c in cols_in], axis=1)
+            preds = cache["m"].predict(x)
+            return pd.Series([[float(v) for v in np.atleast_1d(p)]
+                              for p in preds])
+
+        return dataset.withColumn(out_col,
+                                  _predict(*[col(c) for c in fcols]))
+
     # -- Keras ----------------------------------------------------------
 
     class KerasEstimator(Estimator, _HorovodParams, _BlobPersistence):
@@ -283,33 +318,15 @@ if HAVE_PYSPARK:  # pragma: no cover - real-pyspark lane only
             return inst
 
         def _transform(self, dataset):
-            from pyspark.sql.functions import col, udf
-            from pyspark.sql.types import ArrayType, DoubleType
+            def loader(d):
+                from .keras import KerasModel as PlainModel
 
-            sc = dataset.sparkSession.sparkContext
-            blob = sc.broadcast(dumps(self._payload()))
-            fcols = list(self.getFeatureCols())
-            cache: dict = {}
+                return PlainModel(d["model_blob"], d["weights"],
+                                  d["feature_cols"])
 
-            def _predict(*features):
-                import numpy as np
-
-                if "m" not in cache:
-                    from .keras import KerasModel as PlainModel
-
-                    d = loads(blob.value)
-                    cache["m"] = PlainModel(d["model_blob"], d["weights"],
-                                            d["feature_cols"])
-                row = [f.toArray() if hasattr(f, "toArray") else f
-                       for f in features]
-                x = np.concatenate([np.atleast_1d(
-                    np.asarray(r, dtype=np.float64)) for r in row])
-                pred = cache["m"].predict(x[None, :])[0]
-                return [float(v) for v in np.atleast_1d(pred)]
-
-            fn = udf(_predict, ArrayType(DoubleType()))
-            return dataset.withColumn(
-                self.getOutputCol(), fn(*[col(c) for c in fcols]))
+            return _transform_with(dataset, self._payload(), loader,
+                                   list(self.getFeatureCols()),
+                                   self.getOutputCol())
 
     # -- Torch ----------------------------------------------------------
 
@@ -407,34 +424,15 @@ if HAVE_PYSPARK:  # pragma: no cover - real-pyspark lane only
             return inst
 
         def _transform(self, dataset):
-            from pyspark.sql.functions import col, udf
-            from pyspark.sql.types import ArrayType, DoubleType
+            def loader(d):
+                from .torch import TorchModel as PlainModel
 
-            sc = dataset.sparkSession.sparkContext
-            blob = sc.broadcast(dumps(self._payload()))
-            fcols = list(self.getFeatureCols())
-            cache: dict = {}
+                return PlainModel(d["model_blob"], d["state_dict"],
+                                  d["feature_cols"])
 
-            def _predict(*features):
-                import numpy as np
-
-                if "m" not in cache:
-                    from .torch import TorchModel as PlainModel
-
-                    d = loads(blob.value)
-                    cache["m"] = PlainModel(d["model_blob"],
-                                            d["state_dict"],
-                                            d["feature_cols"])
-                row = [f.toArray() if hasattr(f, "toArray") else f
-                       for f in features]
-                x = np.concatenate([np.atleast_1d(
-                    np.asarray(r, dtype=np.float64)) for r in row])
-                pred = cache["m"].predict(x[None, :])[0]
-                return [float(v) for v in np.atleast_1d(pred)]
-
-            fn = udf(_predict, ArrayType(DoubleType()))
-            return dataset.withColumn(
-                self.getOutputCol(), fn(*[col(c) for c in fcols]))
+            return _transform_with(dataset, self._payload(), loader,
+                                   list(self.getFeatureCols()),
+                                   self.getOutputCol())
 
     __all__ = ["KerasEstimator", "KerasModel", "TorchEstimator",
                "TorchModel", "HAVE_PYSPARK"]
