@@ -64,8 +64,7 @@ def run_config(name: str, iters: int, warmup: int, batch_size: int,
         "baseline": {},
         "bf16_stats": {"bn_f32_stats": False},
         "two_pass_var": {"bn_fast_variance": False},
-    }[name if name in ("baseline", "bf16_stats", "two_pass_var")
-      else "baseline"]
+    }[name]  # unknown names must raise, not silently measure baseline
 
     model = ResNet50(num_classes=1000,
                      dtype=jnp.bfloat16 if on_tpu else jnp.float32,

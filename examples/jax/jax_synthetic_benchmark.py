@@ -7,8 +7,14 @@ Two modes:
 - ``--mode spmd`` (default): the TPU-first path — one jit'd train step over
   the device mesh, gradient sync folded into the step as a psum (XLA fuses
   it with backprop; this is the configuration ``bench.py`` measures).
+- ``--mode wfbp``: the overlapped eager path —
+  ``hvd.make_overlapped_train_step`` compiles forward+backward+allreduce+
+  update into one program over the runtime's process mesh; XLA overlaps
+  the gradient collectives with backward (in-program WFBP,
+  ``docs/perf_r4.md``).
 
 Run: ``hvdrun -np 2 python examples/jax/jax_synthetic_benchmark.py --mode eager``
+     ``hvdrun -np 2 --data-plane xla python examples/jax/jax_synthetic_benchmark.py --mode wfbp``
      ``python examples/jax/jax_synthetic_benchmark.py  # single-process spmd``
 """
 
@@ -20,7 +26,8 @@ import numpy as np
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", default="spmd", choices=["spmd", "eager"])
+    parser.add_argument("--mode", default="spmd",
+                    choices=["spmd", "eager", "wfbp"])
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--num-warmup-batches", type=int, default=2)
@@ -28,7 +35,14 @@ def main():
     parser.add_argument("--num-batches-per-iter", type=int, default=3)
     args = parser.parse_args()
 
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # CI affordance: some environments pin the platform via a
+        # sitecustomize jax.config update, which beats the env var.
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import optax
 
@@ -59,6 +73,29 @@ def main():
         def benchmark_step():
             nonlocal state
             state, loss = step(state, batch)
+            return loss
+    elif args.mode == "wfbp":
+        from horovod_tpu.frameworks.jax.wfbp import make_overlapped_train_step
+
+        state = create_train_state(model, rng, images, tx,
+                                   init_kwargs={"train": True})
+
+        def wfbp_loss(p, bstats, b):
+            out, updates = model.apply(
+                {"params": p, "batch_stats": bstats}, b["x"],
+                train=True, mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(b["y"], 1000)
+            return (optax.softmax_cross_entropy(out, one_hot).mean(),
+                    updates["batch_stats"])
+
+        wstep = make_overlapped_train_step(wfbp_loss, tx, has_aux=True)
+        wp, ws, wa = wstep.init(state.params, tx.init(state.params),
+                                state.batch_stats)
+        wbatch = {"x": images, "y": labels}
+
+        def benchmark_step():
+            nonlocal wp, ws, wa
+            wp, ws, wa, loss = wstep(wp, ws, wbatch, wa)
             return loss
     else:
         from horovod_tpu.frameworks.jax.optimizer import DistributedOptimizer

@@ -201,13 +201,17 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
             count = state.counter + 1
             window = state.window
             if count == 1 and ops.initialized():
-                # Evict abandoned windows (a mid-window exception or a
+                # Evict ABANDONED windows (a mid-window exception or a
                 # discarded train state never flushes): drain their
                 # handles so neither the gradient pytrees nor the handle
-                # events leak.  A few concurrently-open windows is the
-                # legitimate maximum (one per live train state).
-                while len(_windows) > 3:
-                    stale = min(_windows)
+                # events leak.  Staleness is sequence distance, not
+                # count: a live mid-window state can be at most
+                # (#live states) window-ids behind the head, while an
+                # abandoned one falls further behind every new window —
+                # 16 gives room for 16 concurrently-training states
+                # before a pathological workload could evict a live one.
+                for stale in [w for w in _windows
+                              if _window_seq[0] - w >= 16]:
                     for rec in _windows.pop(stale):
                         for h in rec.handles:
                             try:

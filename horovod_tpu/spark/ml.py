@@ -176,33 +176,54 @@ if HAVE_PYSPARK:  # pragma: no cover - real-pyspark lane only
         pandas_udf (one framework predict() per Arrow batch, not per row
         — the reference's batched executor-prediction shape,
         ``spark/keras/estimator.py`` transform path).  ``loader`` maps
-        the broadcast payload dict to a fitted plain model."""
-        from pyspark.sql.functions import col, pandas_udf
+        the broadcast payload dict to a fitted plain model.
+
+        pandas_udf needs pyarrow (declared in the ``spark`` extra); on
+        clusters without it we degrade to the per-row scalar udf —
+        correct, just slower."""
+        from pyspark.sql.functions import col, udf
         from pyspark.sql.types import ArrayType, DoubleType
 
         sc = dataset.sparkSession.sparkContext
         blob = sc.broadcast(dumps(payload))
         cache: dict = {}
 
-        def _to_matrix(series):
-            import numpy as np
-
-            rows = [np.atleast_1d(np.asarray(
-                v.toArray() if hasattr(v, "toArray") else v,
-                dtype=np.float64)) for v in series]
-            return np.stack(rows)
-
-        @pandas_udf(ArrayType(DoubleType()))
-        def _predict(*cols_in):
-            import numpy as np
-            import pandas as pd
-
+        def _model():
             if "m" not in cache:
                 cache["m"] = loader(loads(blob.value))
-            x = np.concatenate([_to_matrix(c) for c in cols_in], axis=1)
-            preds = cache["m"].predict(x)
-            return pd.Series([[float(v) for v in np.atleast_1d(p)]
-                              for p in preds])
+            return cache["m"]
+
+        def _to_row(v):
+            import numpy as np
+
+            return np.atleast_1d(np.asarray(
+                v.toArray() if hasattr(v, "toArray") else v,
+                dtype=np.float64))
+
+        try:
+            import pyarrow  # noqa: F401
+            from pyspark.sql.functions import pandas_udf
+
+            @pandas_udf(ArrayType(DoubleType()))
+            def _predict(*cols_in):
+                import numpy as np
+                import pandas as pd
+
+                x = np.concatenate(
+                    [np.stack([_to_row(v) for v in c]) for c in cols_in],
+                    axis=1)
+                preds = _model().predict(x)
+                return pd.Series([[float(v) for v in np.atleast_1d(p)]
+                                  for p in preds])
+        except ImportError:
+            def _scalar(*features):
+                import numpy as np
+
+                x = np.concatenate([_to_row(f) for f in features])
+                pred = _model().predict(x[None, :])[0]
+                return [float(v) for v in np.atleast_1d(pred)]
+
+            _predict = udf(_scalar, ArrayType(DoubleType()))
 
         return dataset.withColumn(out_col,
                                   _predict(*[col(c) for c in fcols]))

@@ -66,3 +66,15 @@ def test_elastic_tensorflow2_resnet50(tmp_path):
                   extra_cli=["--min-np", "1",
                              "--host-discovery-script", str(discover)])
     assert "ELASTIC RESNET DONE" in out
+
+
+def test_jax_synthetic_wfbp_mode():
+    """The overlapped-step flavor of the native example (docs/perf_r4.md):
+    two ranks, XLA data plane, in-program gradient allreduce."""
+    out = _hvdrun(
+        2, ["examples/jax/jax_synthetic_benchmark.py", "--mode", "wfbp",
+            "--batch-size", "4", "--image-size", "32",
+            "--num-warmup-batches", "1", "--num-iters", "1",
+            "--num-batches-per-iter", "2"],
+        extra_cli=("--data-plane", "xla"), timeout=420)
+    assert "Total img/sec" in out
