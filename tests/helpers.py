@@ -53,15 +53,21 @@ def _timeout_scale() -> float:
         cores = os.cpu_count() or 1
     except OSError:
         return 1.0
-    return max(1.0, min(4.0, load / cores))
+    # Divide by cores-1: on a small box one core's worth of load (the
+    # test runner + harness itself) is the steady state, and a 2-proc
+    # jax worker pair needs real headroom beyond it.
+    return max(1.0, min(6.0, load / max(1, cores - 1)))
 
 
 #: Failure signatures that indicate host-load flakiness (worker starved of
 #: CPU → peer death / handshake timeout), not a product bug.  Only these
-#: trigger the single automatic retry.
+#: trigger the automatic retries.
 _FLAKY_SIGNATURES = (
     "timed out after",
     "peer closed connection",
+    "Connection reset by peer",
+    "recv from rank",
+    "background loop died",
     "could not connect to rank",
     "rendezvous wait timed out",
 )
@@ -71,7 +77,7 @@ def run_distributed(n: int, body: str, timeout: float = 120,
                     extra_env: Optional[Dict[str, str]] = None,
                     expect_failure: bool = False,
                     local_size: Optional[int] = None,
-                    retries: int = 1) -> List[str]:
+                    retries: int = 2) -> List[str]:
     """Run `body` on n worker processes; returns per-rank stdout.
 
     ``local_size`` simulates a host-major multi-host topology (n must
@@ -80,7 +86,7 @@ def run_distributed(n: int, body: str, timeout: float = 120,
     real multi-host.
 
     Timeouts are load-scaled (see ``_timeout_scale``), and a failure whose
-    message matches a known load-starvation signature is retried once —
+    message matches a known load-starvation signature is retried —
     assertion failures in the test body itself are NOT retried."""
     attempt = 0
     while True:
@@ -91,19 +97,17 @@ def run_distributed(n: int, body: str, timeout: float = 120,
         except AssertionError as e:
             attempt += 1
             msg = str(e)
-            headline = msg.split("\n", 1)[0]
-            # Harness-level timeout is always retryable; worker-log
-            # signatures (peer death etc.) only count as flaky when the
-            # host is actually contended — a deterministic connect failure
-            # on an idle box should go red immediately.
-            flaky = "timed out after" in headline or (
-                _timeout_scale() > 1.2
-                and any(sig in msg for sig in _FLAKY_SIGNATURES))
+            # Every signature is specific infrastructure-failure text
+            # (harness timeout, mesh connect/recv faults, peer death) —
+            # never a product assert — so a match is always retryable.
+            # Cost on a genuine deterministic mesh bug: `retries` extra
+            # runs of one test before red.
+            flaky = any(sig in msg for sig in _FLAKY_SIGNATURES)
             if attempt > retries or not flaky:
                 raise
             import time as _time
 
-            _time.sleep(2.0)  # let the loaded box drain before the retry
+            _time.sleep(2.0 * attempt)  # let the loaded box drain
 
 
 def _run_distributed_once(n: int, body: str, timeout: float,

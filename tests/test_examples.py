@@ -12,13 +12,28 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _hvdrun(np_, script_args, timeout=420, extra_cli=()):
+    from .helpers import _FLAKY_SIGNATURES, _timeout_scale
+
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                TF_CPP_MIN_LOG_LEVEL="2")
-    proc = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.runner.launch",
-         "-np", str(np_), *extra_cli, sys.executable, *script_args],
-        cwd=REPO_ROOT, text=True, capture_output=True, timeout=timeout,
-        env=env)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "-np", str(np_), *extra_cli, sys.executable, *script_args]
+    # Same load-scaled-timeout + infra-signature retry policy as
+    # helpers.run_distributed (an example job is just a bigger worker).
+    for attempt in (0, 1, 2):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=REPO_ROOT, text=True, capture_output=True,
+                timeout=timeout * _timeout_scale(), env=env)
+        except subprocess.TimeoutExpired:
+            if attempt == 2:
+                raise
+            continue
+        if proc.returncode == 0:
+            break
+        blob = proc.stdout + proc.stderr
+        if attempt == 2 or not any(s in blob for s in _FLAKY_SIGNATURES):
+            break
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     return proc.stdout
 
