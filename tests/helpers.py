@@ -62,7 +62,7 @@ def _timeout_scale() -> float:
 #: Failure signatures that indicate host-load flakiness (worker starved of
 #: CPU → peer death / handshake timeout), not a product bug.  Only these
 #: trigger the automatic retries.
-_FLAKY_SIGNATURES = (
+FLAKY_SIGNATURES = (
     "timed out after",
     "peer closed connection",
     "Connection reset by peer",
@@ -71,6 +71,36 @@ _FLAKY_SIGNATURES = (
     "could not connect to rank",
     "rendezvous wait timed out",
 )
+_FLAKY_SIGNATURES = FLAKY_SIGNATURES  # back-compat alias
+
+
+class WorkerFailure(AssertionError):
+    """Worker-job failure carrying each failing rank's combined output so
+    the retry gate can judge EVERY rank, not just the first."""
+
+    def __init__(self, message: str, sections: List[str]):
+        super().__init__(message)
+        self.sections = sections
+
+
+def infra_retryable(failure: BaseException) -> bool:
+    """True when a failure is pure infrastructure flakiness.
+
+    For a :class:`WorkerFailure`, EVERY failing rank's output must match
+    an infra signature — a deterministic product crash on one rank
+    surfaces on its *siblings* as peer-death text, so judging only the
+    first failing rank would retry real bugs."""
+    if isinstance(failure, WorkerFailure):
+        return all(any(sig in s for sig in FLAKY_SIGNATURES)
+                   for s in failure.sections) and bool(failure.sections)
+    return any(sig in str(failure) for sig in FLAKY_SIGNATURES)
+
+
+def retry_backoff(attempt: int) -> None:
+    """Shared backoff between infra retries (let the loaded box drain)."""
+    import time as _time
+
+    _time.sleep(2.0 * attempt)
 
 
 def run_distributed(n: int, body: str, timeout: float = 120,
@@ -85,9 +115,10 @@ def run_distributed(n: int, body: str, timeout: float = 120,
     r//local_size — how hierarchical-allreduce paths are tested without
     real multi-host.
 
-    Timeouts are load-scaled (see ``_timeout_scale``), and a failure whose
-    message matches a known load-starvation signature is retried —
-    assertion failures in the test body itself are NOT retried."""
+    Timeouts are load-scaled (see ``_timeout_scale``); a failure is
+    retried only when :func:`infra_retryable` judges every failing rank's
+    output to be infrastructure text — product asserts go red
+    immediately."""
     attempt = 0
     while True:
         try:
@@ -96,18 +127,9 @@ def run_distributed(n: int, body: str, timeout: float = 120,
                 expect_failure, local_size)
         except AssertionError as e:
             attempt += 1
-            msg = str(e)
-            # Every signature is specific infrastructure-failure text
-            # (harness timeout, mesh connect/recv faults, peer death) —
-            # never a product assert — so a match is always retryable.
-            # Cost on a genuine deterministic mesh bug: `retries` extra
-            # runs of one test before red.
-            flaky = any(sig in msg for sig in _FLAKY_SIGNATURES)
-            if attempt > retries or not flaky:
+            if attempt > retries or not infra_retryable(e):
                 raise
-            import time as _time
-
-            _time.sleep(2.0 * attempt)  # let the loaded box drain
+            retry_backoff(attempt)
 
 
 def _run_distributed_once(n: int, body: str, timeout: float,
@@ -149,15 +171,21 @@ def _run_distributed_once(n: int, body: str, timeout: float,
                 for q in procs:
                     q.kill()
                 out, err = p.communicate()
-                raise AssertionError(
-                    f"worker timed out after {timeout:.0f}s\nstdout:\n{out}\nstderr:\n{err}")
+                section = (f"worker timed out after {timeout:.0f}s\n"
+                           f"stdout:\n{out}\nstderr:\n{err}")
+                raise WorkerFailure(section, [section])
             outs.append(out)
             errs.append(err)
             codes.append(p.returncode)
         if not expect_failure:
-            for r, (code, out, err) in enumerate(zip(codes, outs, errs)):
-                assert code == 0 and f"WORKER_OK {r}" in out, (
-                    f"rank {r} failed (exit {code})\nstdout:\n{out}\nstderr:\n{err}")
+            failing = [
+                f"rank {r} failed (exit {code})\nstdout:\n{out}\nstderr:\n{err}"
+                for r, (code, out, err) in enumerate(zip(codes, outs, errs))
+                if code != 0 or f"WORKER_OK {r}" not in out
+            ]
+            if failing:
+                raise WorkerFailure("\n=== next failing rank ===\n"
+                                    .join(failing), failing)
         return outs
     finally:
         for p in procs:

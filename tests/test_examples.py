@@ -11,31 +11,50 @@ import pytest
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _launch_once(cmd, env, timeout):
+    """One launcher invocation in its OWN process group: a timeout kill
+    must reach the worker grandchildren too (killing only the launcher
+    leaves orphans holding the output pipes — communicate() would block
+    on them, and they'd keep loading the box for the retry)."""
+    import signal
+
+    proc = subprocess.Popen(cmd, cwd=REPO_ROOT, text=True, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, err = proc.communicate()
+        return proc.returncode, out, err, True
+
+
 def _hvdrun(np_, script_args, timeout=420, extra_cli=()):
-    from .helpers import _FLAKY_SIGNATURES, _timeout_scale
+    from .helpers import infra_retryable, retry_backoff, _timeout_scale
 
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                TF_CPP_MIN_LOG_LEVEL="2")
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
            "-np", str(np_), *extra_cli, sys.executable, *script_args]
-    # Same load-scaled-timeout + infra-signature retry policy as
+    # Same load-scaled-timeout + infra-retry policy as
     # helpers.run_distributed (an example job is just a bigger worker).
     for attempt in (0, 1, 2):
-        try:
-            proc = subprocess.run(
-                cmd, cwd=REPO_ROOT, text=True, capture_output=True,
-                timeout=timeout * _timeout_scale(), env=env)
-        except subprocess.TimeoutExpired:
-            if attempt == 2:
-                raise
-            continue
-        if proc.returncode == 0:
+        code, out, err, timed_out = _launch_once(
+            cmd, env, timeout * _timeout_scale())
+        if code == 0:
             break
-        blob = proc.stdout + proc.stderr
-        if attempt == 2 or not any(s in blob for s in _FLAKY_SIGNATURES):
+        retryable = timed_out or infra_retryable(
+            AssertionError(out[-4000:] + err[-4000:]))
+        if attempt == 2 or not retryable:
             break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
-    return proc.stdout
+        retry_backoff(attempt + 1)
+    assert code == 0, (out[-2000:], err[-2000:])
+    return out
 
 
 def test_keras_mnist(tmp_path):
