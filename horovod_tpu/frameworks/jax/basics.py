@@ -8,6 +8,7 @@ in-process — but the API surface and semantics match.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ...common.exceptions import HorovodInternalError
@@ -66,12 +67,34 @@ def _maybe_init_jax_distributed(topology: Optional[ProcessTopology]) -> None:
             "the TCP data plane", e)
 
 
+def _honor_jax_platforms_env() -> None:
+    """Make an EXPLICIT ``JAX_PLATFORMS`` env win over site-level config.
+
+    Some deployments pin the platform via a sitecustomize
+    ``jax.config.update`` at import time, which silently overrides the
+    documented env contract — a worker launched with ``JAX_PLATFORMS=cpu``
+    would still grab the accelerator (two ranks then contend for one
+    chip).  Re-assert the env value before first device use; if backends
+    are already latched the update raises and we leave things be."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        if str(getattr(jax.config, "jax_platforms", None) or "") != plat:
+            jax.config.update("jax_platforms", plat)
+    except Exception:  # noqa: BLE001 — backend already initialized
+        pass
+
+
 def init(store: Optional[Store] = None,
          topology: Optional[ProcessTopology] = None) -> None:
     """Initialize the runtime: topology from the launcher env (or given
     explicitly), TCP mesh rendezvous when size > 1, background thread up.
 
     Reference: ``hvd.init()`` → ``horovod_init`` (``operations.cc:752``)."""
+    _honor_jax_platforms_env()
     _maybe_init_jax_distributed(topology)
     global_state().initialize(store=store, topology=topology)
     from ...common import env as env_mod

@@ -164,19 +164,40 @@ def _run_distributed_once(n: int, body: str, timeout: float,
                 env=env, cwd=REPO_ROOT, text=True,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE))
         outs, errs, codes = [], [], []
-        for p in procs:
+        timed_out_rank = None
+        for r, p in enumerate(procs):
             try:
                 out, err = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
+                # Kill the whole job but KEEP collecting: a sibling that
+                # crashed with a product error must contribute its section
+                # to the retry gate — timeout text alone would always look
+                # like infra flakiness and retry real bugs.
+                if timed_out_rank is None:
+                    timed_out_rank = r
                 for q in procs:
                     q.kill()
                 out, err = p.communicate()
-                section = (f"worker timed out after {timeout:.0f}s\n"
-                           f"stdout:\n{out}\nstderr:\n{err}")
-                raise WorkerFailure(section, [section])
             outs.append(out)
             errs.append(err)
             codes.append(p.returncode)
+        if timed_out_rank is not None:
+            sections = []
+            for r, (code, out, err) in enumerate(zip(codes, outs, errs)):
+                if r == timed_out_rank:
+                    head = f"worker timed out after {timeout:.0f}s"
+                elif code == 0 and f"WORKER_OK {r}" in out:
+                    continue
+                elif code and code < 0:
+                    # our own post-timeout kill — infra by construction
+                    head = (f"rank {r} killed after sibling timed out "
+                            f"after {timeout:.0f}s")
+                else:
+                    head = f"rank {r} failed (exit {code}) before timeout"
+                sections.append(
+                    f"{head}\nstdout:\n{out}\nstderr:\n{err}")
+            raise WorkerFailure("\n=== next failing rank ===\n"
+                                .join(sections), sections)
         if not expect_failure:
             failing = [
                 f"rank {r} failed (exit {code})\nstdout:\n{out}\nstderr:\n{err}"

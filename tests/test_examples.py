@@ -41,19 +41,25 @@ def _hvdrun(np_, script_args, timeout=420, extra_cli=()):
                TF_CPP_MIN_LOG_LEVEL="2")
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
            "-np", str(np_), *extra_cli, sys.executable, *script_args]
-    # Same load-scaled-timeout + infra-retry policy as
-    # helpers.run_distributed (an example job is just a bigger worker).
+    # Same load-scaled-timeout + infra-retry intent as
+    # helpers.run_distributed.  The launcher interleaves rank streams, so
+    # the per-rank gate is approximated: retry only when infra text is
+    # present AND no product-assert marker is — one rank's peer-death
+    # text must not mask a sibling's real crash.
     for attempt in (0, 1, 2):
         code, out, err, timed_out = _launch_once(
             cmd, env, timeout * _timeout_scale())
         if code == 0:
             break
-        retryable = timed_out or infra_retryable(
-            AssertionError(out[-4000:] + err[-4000:]))
+        blob = out + err
+        retryable = (timed_out or infra_retryable(AssertionError(blob))) \
+            and "AssertionError" not in blob
         if attempt == 2 or not retryable:
             break
         retry_backoff(attempt + 1)
-    assert code == 0, (out[-2000:], err[-2000:])
+    assert code == 0, (
+        f"timed_out={timed_out} (budget {timeout * _timeout_scale():.0f}s)",
+        out[-2000:], err[-2000:])
     return out
 
 
@@ -83,9 +89,12 @@ def test_pytorch_imagenet_resnet50(tmp_path):
 
 
 def test_adasum_bert_pretraining():
+    # Two ranks each compile the BERT pretraining step — the heaviest
+    # compile in the suite; the default 420 s budget is marginal even
+    # before load scaling (sole failure of full runs 3 and 4).
     out = _hvdrun(2, ["examples/adasum/adasum_bert_pretraining.py",
                       "--steps", "3", "--batch-size", "2",
-                      "--seq-len", "16"])
+                      "--seq-len", "16"], timeout=900)
     assert "ADASUM BERT DONE" in out
 
 
