@@ -693,8 +693,12 @@ class XlaAlltoall(XlaOp):
         weak #4)."""
         if isinstance(e, NotImplementedError):
             return True
-        msg = str(e).upper()
-        return any(tok in msg for tok in (
+        # Anchored status-code prefixes only (ADVICE r4): a transient
+        # runtime fault whose message merely *contains* one of these
+        # tokens (e.g. an INTERNAL error quoting an unsupported-layout
+        # detail) must NOT flip the sticky fallback on one rank.
+        msg = str(e).upper().lstrip()
+        return msg.startswith((
             "UNIMPLEMENTED", "NOT IMPLEMENTED", "UNSUPPORTED",
             "NO LOWERING", "NOT SUPPORTED", "CANNOT LOWER"))
 
@@ -720,6 +724,13 @@ class XlaAlltoall(XlaOp):
         inner = tuple(entry.tensor.shape[1:])
         inner_n = int(np.prod(inner)) if inner else 1
 
+        # Deterministic capability pre-check (same jax build on every
+        # rank): a missing lax.ragged_all_to_all must not be discovered
+        # via a rank-local AttributeError mid-dispatch, where it would be
+        # indistinguishable from a transient fault.
+        if not XlaAlltoall._ragged_broken and \
+                not hasattr(jax.lax, "ragged_all_to_all"):
+            XlaAlltoall._ragged_broken = True
         if (not XlaAlltoall._ragged_broken
                 and _device_platform(ctx) == "tpu"):
             try:
@@ -735,8 +746,14 @@ class XlaAlltoall(XlaOp):
                     # every rank sees the same error path — do NOT change
                     # the lowering choice for future dispatches.
                     raise
-                log.warning("ragged_all_to_all unavailable (%s); using "
-                            "bucketed AllToAll", e)
+                # ERROR, not warning: if this ever flips on one rank only,
+                # the mesh's lowering choices desync — make the flip
+                # unmissable in every rank's log for diagnosis.
+                log.error(
+                    "rank %s: ragged_all_to_all capability probe failed "
+                    "(%s: %s); STICKY fallback to bucketed AllToAll for "
+                    "the rest of this process", self.topo.rank,
+                    type(e).__name__, e)
                 XlaAlltoall._ragged_broken = True
 
         bucket = bucket_elems(max(max(matrix, default=1), 1) * inner_n)

@@ -31,13 +31,18 @@ class HandleManager:
     def mark_done(self, handle: int, status: Status, result: Any = None) -> None:
         with self._lock:
             event = self._events.get(handle)
+            if event is None:
+                # Handle was discarded (abandoned window / failed enqueue):
+                # drop the late result instead of resurrecting the entry —
+                # nobody will ever wait on it.
+                return
             self._done[handle] = (status, result)
-        if event is not None:
-            event.set()
+        event.set()
 
     def discard(self, handle: int) -> None:
-        """Release a handle whose enqueue failed before any callback could
-        fire (prevents unbounded Event growth under retry loops)."""
+        """Release a handle nobody will wait on (failed enqueue, or an
+        abandoned window whose collective never completed).  A callback
+        that fires later is dropped by ``mark_done``."""
         with self._lock:
             self._events.pop(handle, None)
             self._done.pop(handle, None)

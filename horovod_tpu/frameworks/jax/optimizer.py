@@ -19,13 +19,81 @@ no wrapper at all.
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Any, NamedTuple, Optional
 
 import numpy as np
 
 from . import ops, wfbp
-from .compression import Compression
 from ...common.exceptions import HorovodInternalError
+from ...common.logging_util import get_logger
+from .compression import Compression
+
+log = get_logger(__name__)
+
+# Abandoned-window drainer: a mid-window exception or a discarded train
+# state leaves enqueued collectives in flight.  If the abandonment was
+# asymmetric across ranks (one rank raised mid-window), those collectives
+# may NEVER complete — so the training path must not block on them
+# (ADVICE r4 medium).  Eviction hands the handles to this shared daemon,
+# which polls non-blockingly, releases completed ones, and force-discards
+# the rest after a deadline.
+_instance_ids = itertools.count()
+
+_DRAIN_TIMEOUT_S = 120.0
+_drain_lock = threading.Lock()
+_drain_queue: list = []      # (handle, deadline) pairs
+_drain_thread: Optional[threading.Thread] = None
+
+
+def _drain_handles_async(handles, timeout_s: float = _DRAIN_TIMEOUT_S):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    global _drain_thread
+    with _drain_lock:
+        _drain_queue.extend((h, deadline, timeout_s) for h in handles)
+        if _drain_queue and (_drain_thread is None
+                             or not _drain_thread.is_alive()):
+            _drain_thread = threading.Thread(
+                target=_drain_loop, name="hvd-window-drainer", daemon=True)
+            _drain_thread.start()
+
+
+def _drain_loop():
+    import time
+
+    global _drain_thread
+    while True:
+        with _drain_lock:
+            items, _drain_queue[:] = list(_drain_queue), []
+        keep = []
+        for h, deadline, timeout_s in items:
+            if ops.poll(h):
+                try:
+                    ops.synchronize(h)  # completed: instant, releases
+                except Exception:  # noqa: BLE001 — draining, result unused
+                    pass
+            elif time.monotonic() >= deadline:
+                log.warning(
+                    "dropping abandoned in-flight collective (handle %d): "
+                    "it did not complete within %.1fs of window eviction — "
+                    "likely an asymmetric mid-window failure across ranks",
+                    h, timeout_s)
+                ops._handles.discard(h)
+            else:
+                keep.append((h, deadline, timeout_s))
+        with _drain_lock:
+            _drain_queue.extend(keep)
+            if not _drain_queue:
+                # Retire INSIDE the lock: a concurrent eviction that just
+                # saw this thread alive (and so didn't start a new one)
+                # must not race our exit — clearing the slot here forces
+                # the next hand-off to spawn a fresh drainer.
+                _drain_thread = None
+                return
+        time.sleep(0.5)
 
 try:
     import optax
@@ -111,7 +179,8 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
                          average_aggregated_gradients: bool = True,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         overlap: bool = False):
+                         overlap: bool = False,
+                         name: Optional[str] = None):
     """Wrap an optax transformation with cross-rank gradient allreduce.
 
     With ``backward_passes_per_step=N`` gradients accumulate locally and the
@@ -147,7 +216,8 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
                 "optimizer steps; wrap tx in optax.MultiSteps instead)")
         if overlap:
             raise ValueError("overlap=True is not supported with op=Adasum")
-        return DistributedAdasumOptimizer(tx, compression=compression)
+        return DistributedAdasumOptimizer(tx, compression=compression,
+                                          name=name)
     if overlap and backward_passes_per_step < 2:
         raise ValueError(
             "overlap=True needs backward_passes_per_step >= 2 (there is no "
@@ -155,6 +225,24 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
             "use make_overlapped_train_step, which overlaps comm with "
             "backward inside one compiled program")
     n_accum = backward_passes_per_step
+
+    # Per-instance wire-name prefix: two DistributedOptimizer instances
+    # training concurrently in one process (two models) must not collide
+    # on in-flight tensor names (reference exposes the same lever as the
+    # factory's ``name`` arg, ``tensorflow/__init__.py:465``).  An
+    # explicit ``name`` wins; otherwise a nonce is drawn LAZILY at the
+    # first *communicating* update, so the cross-rank contract is
+    # "communicating optimizers update in the same order" — a rank-local
+    # instance that never syncs (e.g. an eval-only optimizer built on
+    # rank 0) consumes no id and cannot shift its siblings' names.
+    # Names stay stable across steps, keeping the ResponseCache
+    # bitvector fast path warm.
+    _root = [f"grad.{name}" if name else None]
+
+    def _name_root() -> str:
+        if _root[0] is None:
+            _root[0] = f"grad.opt{next(_instance_ids)}"
+        return _root[0]
 
     # Every pure piece of the update runs under jit (compiled lazily, once
     # per optimizer instance): eager per-leaf tree_maps would dispatch two
@@ -186,10 +274,13 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
     # Overlap mode: in-flight microbatch windows, keyed by the window id
     # carried IN the optimizer state (PendingTree handles are
     # process-local and cannot ride a checkpointable pytree).  Keying by
-    # state — not a bare factory-scoped list — keeps two train states
-    # sharing one DistributedOptimizer from cross-mixing windows, and
-    # turns a restored/replayed mid-window state into a loud error
-    # instead of silently wrong gradients.
+    # state turns a restored/replayed mid-window state into a loud error
+    # instead of silently wrong gradients.  NOTE: two train states
+    # INTERLEAVING microbatches through one overlap=True instance remain
+    # unsupported — their windows would enqueue duplicate in-flight wire
+    # names (same `name_root`, same mb index) and the runtime raises
+    # "already in flight"; use one DistributedOptimizer per train state
+    # (each gets its own `name_root`).
     _windows: dict = {}
     _window_seq = [0]
 
@@ -202,22 +293,24 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
             window = state.window
             if count == 1 and ops.initialized():
                 # Evict ABANDONED windows (a mid-window exception or a
-                # discarded train state never flushes): drain their
-                # handles so neither the gradient pytrees nor the handle
-                # events leak.  Staleness is sequence distance, not
-                # count: a live mid-window state can be at most
-                # (#live states) window-ids behind the head, while an
-                # abandoned one falls further behind every new window —
-                # 16 gives room for 16 concurrently-training states
-                # before a pathological workload could evict a live one.
+                # discarded train state never flushes): hand their handles
+                # to the background drainer so neither the gradient pytrees
+                # nor the handle events leak.  Never block here — an
+                # asymmetric abandonment (one rank raised mid-window) can
+                # leave collectives that will never complete, and a
+                # blocking drain would stall the NEW window's first
+                # microbatch on them (ADVICE r4 medium).  Staleness is
+                # sequence distance, not count: a live mid-window state can
+                # be at most (#live states) window-ids behind the head,
+                # while an abandoned one falls further behind every new
+                # window — 16 gives room for 16 concurrently-training
+                # states before a pathological workload could evict a live
+                # one.
                 for stale in [w for w in _windows
                               if _window_seq[0] - w >= 16]:
-                    for rec in _windows.pop(stale):
-                        for h in rec.handles:
-                            try:
-                                ops.synchronize(h)
-                            except Exception:  # noqa: BLE001 — draining
-                                pass
+                    _drain_handles_async(
+                        [h for rec in _windows.pop(stale)
+                         for h in rec.handles])
                 _window_seq[0] += 1
                 window = _window_seq[0]
                 _windows[window] = []
@@ -237,7 +330,8 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
                 # backward.  Wait only at the flush.
                 pending.append(wfbp.enqueue_tree_fused(
                     grads, op_name, compression, prescale_factor,
-                    postscale_factor, name_prefix=f"grad.mb{count - 1}"))
+                    postscale_factor,
+                    name_prefix=f"{_name_root()}.mb{count - 1}"))
                 if count < n_accum:
                     zeros = _jitted(
                         "zeros",
@@ -290,7 +384,8 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
             # np=1 (allreduce is never skipped on size); matching that
             # keeps single-process behavior — and overhead — honest.
             grads = _allreduce_tree(grads, op_name, compression,
-                                    prescale_factor, postscale_factor)
+                                    prescale_factor, postscale_factor,
+                                    name_prefix=_name_root())
         updates, inner = _jitted("update", tx.update)(
             grads, state.inner_state, params)
         return updates, DistributedState(inner, new_acc, count)
@@ -298,7 +393,8 @@ def DistributedOptimizer(tx, op: Optional[str] = None,
     return optax.GradientTransformation(init, update)
 
 
-def DistributedAdasumOptimizer(tx, compression=Compression.none):
+def DistributedAdasumOptimizer(tx, compression=Compression.none,
+                               name: Optional[str] = None):
     """Adasum in DELTA space (reference ``_DistributedAdasumOptimizer``,
     ``tensorflow/__init__.py:368-462`` / ``torch/optimizer.py:210-379``):
     instead of combining *gradients*, each rank computes its local
@@ -314,6 +410,16 @@ def DistributedAdasumOptimizer(tx, compression=Compression.none):
     """
     if optax is None:  # pragma: no cover
         raise ImportError("optax is required for DistributedAdasumOptimizer")
+
+    # Same wire-name isolation as DistributedOptimizer: explicit name, or
+    # a lazy nonce drawn at the first communicating update, so two Adasum
+    # optimizers in one process cannot collide on in-flight delta names.
+    _root = [f"adasum.{name}" if name else None]
+
+    def _name_root() -> str:
+        if _root[0] is None:
+            _root[0] = f"adasum.opt{next(_instance_ids)}"
+        return _root[0]
 
     _jits: dict = {}
 
@@ -332,7 +438,7 @@ def DistributedAdasumOptimizer(tx, compression=Compression.none):
         if ops.initialized():
             updates = _allreduce_tree_per_leaf(
                 updates, ops.Adasum, compression, 1.0, 1.0,
-                name_prefix="adasum.delta")
+                name_prefix=f"{_name_root()}.delta")
         return updates, inner
 
     return optax.GradientTransformation(init, update)

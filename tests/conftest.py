@@ -16,9 +16,11 @@ import sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Containerized CI reports the HOST's loadavg (≈0 even when this cgroup's
 # cores are saturated), so the load-reactive timeout scale in
-# tests/helpers.py never engages there.  Default to a 3x floor — a
-# timeout only binds when something is already slow, so healthy runs pay
-# nothing and starved multi-process workers get real headroom.
+# tests/helpers.py never engages there.  Default to a 3x floor — the
+# load-reactive scale can still exceed it on a genuinely loaded bare
+# host (helpers._timeout_scale takes max(floor, load_scale)).  A timeout
+# only binds when something is already slow, so healthy runs pay nothing
+# and starved multi-process workers get real headroom.
 os.environ.setdefault("HVD_TEST_TIMEOUT_SCALE", "3")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

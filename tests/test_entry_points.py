@@ -1,0 +1,196 @@
+"""Driver entry points must survive a wedged accelerator backend.
+
+Round 4 lost both scoreboard artifacts to a hung TPU: ``jax.devices()``
+blocked forever inside ``dryrun_multichip`` (rc=124) and raised UNAVAILABLE
+inside ``bench.py`` (rc=1, no JSON).  These tests pin the defenses:
+
+- ``bench._probe_accelerator`` bounds backend init in a subprocess and
+  reports structured outcomes (timeout vs error) instead of propagating.
+- ``bench.py`` degrades to the CPU mini-bench with ``"error":
+  "tpu_unavailable"`` when the probe fails — still rc=0, still ONE JSON line.
+- ``__graft_entry__._ensure_devices`` pins the platform to CPU *before* the
+  first backend lookup, so a backend that hangs unless explicitly pinned to
+  CPU (exactly how the wedged axon tunnel behaved) cannot stall the dryrun.
+
+The wedge is simulated with a ``sitecustomize`` shim (imported automatically
+by any child python) that makes ``jax.devices()`` sleep forever unless the
+live jax config says "cpu" — the same observable behavior as the round-4
+infra failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import bench  # noqa: E402
+
+_WEDGE_SITE = textwrap.dedent(
+    """
+    # Fake wedged accelerator: jax.devices()/backends() hang unless the
+    # platform is explicitly pinned to cpu — mirrors the round-4 axon
+    # tunnel wedge (jax.devices() >120s, no error).
+    import os
+    if os.environ.get("HVD_FAKE_WEDGE") == "1":
+        import time
+        import jax
+
+        _orig_devices = jax.devices
+
+        def _wedged_devices(*a, **k):
+            if "cpu" in str(jax.config.jax_platforms or ""):
+                return _orig_devices(*a, **k)
+            time.sleep(3600)
+
+        jax.devices = _wedged_devices
+        import jax._src.xla_bridge as _xb
+
+        _orig_backends = _xb.backends
+
+        def _wedged_backends(*a, **k):
+            if "cpu" in str(jax.config.jax_platforms or ""):
+                return _orig_backends(*a, **k)
+            time.sleep(3600)
+
+        _xb.backends = _wedged_backends
+    """
+)
+
+
+@pytest.fixture()
+def wedged_env(tmp_path):
+    """Env dict whose child pythons see a hanging non-CPU backend."""
+    (tmp_path / "sitecustomize.py").write_text(_WEDGE_SITE)
+    env = os.environ.copy()
+    env["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO_ROOT}"
+    env["HVD_FAKE_WEDGE"] = "1"
+    env.pop("JAX_PLATFORMS", None)  # the exact round-4 driver condition
+    return env
+
+
+@pytest.mark.smoke
+def test_probe_timeout_is_bounded_and_structured():
+    res = bench._probe_accelerator(
+        timeout_s=1.0, retries=2, retry_delay_s=0.1,
+        probe_src="import time; time.sleep(60)")
+    assert res["ok"] is False
+    assert [a["outcome"] for a in res["attempts"]] == ["timeout", "timeout"]
+
+
+@pytest.mark.smoke
+def test_probe_error_captures_stderr_tail():
+    res = bench._probe_accelerator(
+        timeout_s=30.0, retries=1, retry_delay_s=0.0,
+        probe_src="raise RuntimeError('UNAVAILABLE: TPU backend wedged')")
+    assert res["ok"] is False
+    (attempt,) = res["attempts"]
+    assert attempt["outcome"] == "error"
+    assert "UNAVAILABLE" in attempt["stderr_tail"]
+
+
+@pytest.mark.smoke
+def test_probe_success_reports_platform():
+    res = bench._probe_accelerator(
+        timeout_s=30.0, retries=3, retry_delay_s=0.0,
+        probe_src="print('HVD_PROBE_OK fakeplat 4')")
+    assert res == {"ok": True, "platform": "fakeplat", "n_devices": 4,
+                   "attempts": []}
+
+
+@pytest.mark.smoke
+def test_probe_retries_then_succeeds(tmp_path):
+    # Child python startup alone costs ~10s here (the axon sitecustomize
+    # imports jax), so the timeout must comfortably cover startup while
+    # still cutting off the first attempt's sleep.
+    flag = tmp_path / "second_try"
+    src = (
+        "import os, sys, time\n"
+        f"p = {str(flag)!r}\n"
+        "if not os.path.exists(p):\n"
+        "    open(p, 'w').close(); time.sleep(300)\n"
+        "print('HVD_PROBE_OK cpu 1')\n"
+    )
+    res = bench._probe_accelerator(timeout_s=30.0, retries=3,
+                                   retry_delay_s=0.1, probe_src=src)
+    assert res["ok"] is True
+    assert [a["outcome"] for a in res["attempts"]] == ["timeout"]
+
+
+def test_ensure_devices_survives_wedged_backend(wedged_env):
+    """_ensure_devices must pin CPU before any backend lookup: with the
+    wedge active and no JAX_PLATFORMS pin from outside, an unpinned
+    jax.devices() would sleep an hour."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; d = g._ensure_devices(8); "
+         "print('GOT', len(d), d[0].platform)"],
+        capture_output=True, text=True, timeout=240, env=wedged_env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr
+    assert "GOT 8 cpu" in proc.stdout
+
+
+def test_bench_degrades_to_structured_error_on_wedge(wedged_env):
+    """bench.py under a wedged accelerator: probe times out (bounded),
+    CPU fallback still produces the one JSON line, rc=0, error field set."""
+    wedged_env.update({
+        "HVD_BENCH_PROBE_TIMEOUT_S": "20",
+        "HVD_BENCH_PROBE_RETRIES": "2",
+        "HVD_BENCH_BATCH": "2",
+        "HVD_BENCH_IMAGE": "32",
+        "HVD_BENCH_WARMUP": "1",
+        "HVD_BENCH_ITERS": "2",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=600, env=wedged_env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["error"] == "tpu_unavailable", rec
+    assert rec["probe"]["ok"] is False, rec
+    assert rec["metric"] == "resnet50_synthetic_images_per_sec_per_chip"
+    assert rec["value"] > 0  # CPU mini-bench actually ran
+
+
+@pytest.mark.smoke
+def test_bench_guard_emits_json_on_crash(tmp_path, monkeypatch):
+    """Any in-process bench failure still prints one parseable JSON line
+    with rc=0 (the round-4 rc=1 mode is unreachable)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import os; os.environ['HVD_BENCH_WATCHDOG_S'] = '5'\n"
+         "import bench\n"
+         "bench.main = lambda: (_ for _ in ()).throw(RuntimeError('boom'))\n"
+         "bench._run_guarded()"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT}, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["error"] == "bench_failed"
+    assert "boom" in rec["exception"]
+
+
+@pytest.mark.smoke
+def test_bench_watchdog_converts_hang_to_json():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import os; os.environ['HVD_BENCH_WATCHDOG_S'] = '2'\n"
+         "import time, bench\n"
+         "bench.main = lambda: time.sleep(60)\n"
+         "bench._run_guarded()"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT}, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["error"] == "tpu_hang"

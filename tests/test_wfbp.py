@@ -236,3 +236,97 @@ else:
 """, timeout=300, extra_env=_xla_env())
     for r, o in enumerate(out):
         assert f"WFBP_SIG_OK {r}" in o
+
+@pytest.mark.smoke
+def test_abandoned_window_drain_is_nonblocking(monkeypatch):
+    """Evicting an abandoned overlap window must never block update()
+    (ADVICE r4 medium): a handle that never completes is handed to the
+    background drainer and force-discarded after its deadline — the
+    training path returns immediately."""
+    import time
+
+    from horovod_tpu.frameworks.jax import ops, optimizer
+
+    # A handle nobody will ever complete (the asymmetric-abandonment case).
+    stuck = ops._handles.allocate()
+    # And one already completed: the drainer must release it promptly.
+    done = ops._handles.allocate()
+    from horovod_tpu.core.tensor_queue import Status
+    ops._handles.mark_done(done, Status.OK(), "result")
+
+    t0 = time.monotonic()
+    optimizer._drain_handles_async([stuck, done], timeout_s=1.5)
+    assert time.monotonic() - t0 < 0.5, "drain hand-off must not block"
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with ops._handles._lock:
+            gone = (stuck not in ops._handles._events
+                    and done not in ops._handles._events)
+        if gone:
+            break
+        time.sleep(0.2)
+    with ops._handles._lock:
+        assert stuck not in ops._handles._events, "stuck handle not discarded"
+        assert done not in ops._handles._events, "done handle not released"
+        assert stuck not in ops._handles._done
+        assert done not in ops._handles._done
+
+    # A callback that fires AFTER the discard must not resurrect the entry.
+    ops._handles.mark_done(stuck, Status.OK(), "late")
+    with ops._handles._lock:
+        assert stuck not in ops._handles._done
+
+
+@pytest.mark.smoke
+def test_optimizer_instances_get_distinct_wire_names(monkeypatch):
+    """Two DistributedOptimizer instances in one process must enqueue
+    under distinct wire-name prefixes (ADVICE r4: identical names across
+    instances break concurrent training states loudly)."""
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.frameworks.jax import ops, optimizer, wfbp
+
+    recorded = []
+
+    def fake_async(tensor, name=None, op=None, **kw):
+        recorded.append(name)
+        h = ops._handles.allocate()
+        from horovod_tpu.core.tensor_queue import Status
+        ops._handles.mark_done(h, Status.OK(), tensor)
+        return h
+
+    monkeypatch.setattr(wfbp.ops, "allreduce_async", fake_async)
+    monkeypatch.setattr(optimizer.ops, "initialized", lambda: True)
+
+    grads = {"w": jnp.ones((2, 2), jnp.float32)}
+    names = {}
+    for i in range(2):
+        recorded.clear()
+        d = optimizer.DistributedOptimizer(optax.sgd(0.1))
+        st = d.init(grads)
+        d.update(grads, st, grads)
+        assert recorded, "no enqueue recorded"
+        names[i] = set(recorded)
+    assert names[0] and names[1]
+    assert names[0].isdisjoint(names[1]), (names, "wire names collide "
+                                           "across optimizer instances")
+
+
+@pytest.mark.smoke
+def test_timeout_scale_env_is_floor(monkeypatch):
+    """HVD_TEST_TIMEOUT_SCALE is a FLOOR: a loaded bare host can scale
+    past it (ADVICE r4 low — it used to be a fixed override)."""
+    from . import helpers
+
+    monkeypatch.setenv("HVD_TEST_TIMEOUT_SCALE", "3")
+    monkeypatch.setattr(helpers.os, "getloadavg", lambda: (20.0, 0, 0))
+    monkeypatch.setattr(helpers.os, "cpu_count", lambda: 2)
+    assert helpers._timeout_scale() == 6.0  # load wins, capped at 6
+
+    monkeypatch.setattr(helpers.os, "getloadavg", lambda: (0.0, 0, 0))
+    assert helpers._timeout_scale() == 3.0  # floor wins on idle/containers
+
+    monkeypatch.delenv("HVD_TEST_TIMEOUT_SCALE")
+    assert helpers._timeout_scale() == 1.0
