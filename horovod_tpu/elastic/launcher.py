@@ -35,15 +35,30 @@ log = get_logger("horovod_tpu.elastic.launcher")
 
 
 def launch_elastic_job(args, command: List[str]) -> int:
-    if args.host_discovery_script:
+    mode = args.host_discovery or (
+        "script" if args.host_discovery_script else None)
+    hosts_str = args.hosts
+    if args.hostfile:
+        hosts_str = parse_host_files(args.hostfile)
+    if mode == "tpu-metadata":
+        from .tpu_metadata import TpuMetadataDiscovery
+
+        if not hosts_str:
+            raise SystemExit(
+                "hvdrun: --host-discovery tpu-metadata needs the slice "
+                "membership via -H/--hostfile (discovery decides which of "
+                "those hosts are currently healthy)")
+        discovery = TpuMetadataDiscovery(
+            parse_hosts(hosts_str),
+            url_template=getattr(args, "tpu_metadata_url", None))
+    elif mode == "script":
+        if not args.host_discovery_script:
+            raise SystemExit("hvdrun: --host-discovery script needs "
+                             "--host-discovery-script")
         discovery = HostDiscoveryScript(args.host_discovery_script)
     else:
-        hosts_str = args.hosts
-        if args.hostfile:
-            hosts_str = parse_host_files(args.hostfile)
-        if not hosts_str:
-            hosts_str = f"localhost:{args.num_proc}"
-        discovery = FixedHosts(parse_hosts(hosts_str))
+        discovery = FixedHosts(parse_hosts(
+            hosts_str or f"localhost:{args.num_proc}"))
 
     from ..common import secret as secret_mod
 

@@ -1,0 +1,222 @@
+"""TPU-preemption host discovery via GCE metadata notices.
+
+Reference analog: the pluggable ``HostDiscovery`` family
+(``/root/reference/horovod/runner/elastic/discovery.py:130-163``), which on
+GPU clusters is a user script listing healthy hosts.  SURVEY §5.3 maps that
+to "TPU pod-slice health/preemption notices": on GCE, a preemptible TPU VM
+learns about its own termination through the instance metadata server —
+``instance/preempted`` flips to ``TRUE`` and ``instance/maintenance-event``
+announces host maintenance ~60 s ahead.  This module makes those notices a
+first-class discovery source, so elastic jobs on preemptible TPU VMs
+(BASELINE config #5) need no hand-written discovery script.
+
+Two pieces:
+
+- :class:`TpuMetadataDiscovery` — driver-side.  Polls, for every candidate
+  host, ``{base}/preempted`` and ``{base}/maintenance-event`` and reports
+  the hosts that are neither preempted nor scheduled for termination.  The
+  URL is a template with a ``{host}`` placeholder: the GCE metadata server
+  (``metadata.google.internal``) is only reachable from the VM it
+  describes, so the default template points at the per-host relay below.
+  Tests and non-GCE deployments point it anywhere
+  (``HOROVOD_TPU_METADATA_URL``).
+
+- :func:`serve_metadata_relay` — worker-side.  A tiny HTTP server each TPU
+  VM runs (``python -m horovod_tpu.elastic.tpu_metadata``) that proxies
+  GET requests to its local metadata server with the required
+  ``Metadata-Flavor: Google`` header.  Run it from the VM startup script
+  alongside the worker.
+
+Wiring: ``hvdrun --host-discovery tpu-metadata -H host1:8,host2:8 ...``
+(the host list is the slice's full membership; discovery decides, per
+poll, which of them are currently healthy).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import urllib.error
+import urllib.request
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from ..common.logging_util import get_logger
+from ..runner.hosts import HostInfo
+from .discovery import HostDiscovery
+
+log = get_logger("horovod_tpu.elastic.tpu_metadata")
+
+#: Port the per-host relay serves on (driver polls ``http://host:PORT``).
+DEFAULT_RELAY_PORT = 8677
+
+DEFAULT_URL_TEMPLATE = (
+    "http://{host}:%d/computeMetadata/v1/instance" % DEFAULT_RELAY_PORT)
+
+#: ``maintenance-event`` values that mean "this host is going away".
+#: (``MIGRATE_ON_HOST_MAINTENANCE`` live-migrates without a restart and is
+#: not a removal signal.)
+_TERMINAL_EVENTS = ("TERMINATE",)
+
+
+def _get(url: str, timeout: float) -> str:
+    req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+class TpuMetadataDiscovery(HostDiscovery):
+    """Reports the subset of ``hosts`` not flagged by preemption notices.
+
+    Host states per poll:
+
+    - **ok** — reachable, ``preempted`` ≠ TRUE, no terminal maintenance
+      event → listed.
+    - **preempted / terminating** — dropped immediately (GCE gives ~30-60 s
+      of notice; the sooner the epoch turns, the less work is lost).
+    - **unreachable** — kept for ``unreachable_grace`` consecutive failed
+      polls, then dropped.  A preempted VM usually stops answering before
+      (or instead of) flipping the flag, so unreachability IS the common
+      preemption signal — but a single dropped packet must not churn the
+      membership.
+    """
+
+    def __init__(self, hosts: List[HostInfo],
+                 url_template: Optional[str] = None,
+                 timeout: float = 2.0,
+                 unreachable_grace: int = 3,
+                 max_pollers: int = 16):
+        self._hosts = {h.hostname: h.slots for h in hosts}
+        self._url = (url_template
+                     or os.environ.get("HOROVOD_TPU_METADATA_URL")
+                     or DEFAULT_URL_TEMPLATE)
+        if "{host}" not in self._url:
+            raise ValueError(
+                "tpu-metadata URL template must contain '{host}' "
+                f"(got {self._url!r})")
+        self._timeout = timeout
+        self._grace = unreachable_grace
+        self._fail_counts: Dict[str, int] = defaultdict(int)
+        self._pool = ThreadPoolExecutor(
+            max_workers=min(max_pollers, max(1, len(hosts))),
+            thread_name_prefix="tpu-metadata-poll")
+        self._lock = threading.Lock()
+
+    # -- per-host probe -------------------------------------------------
+
+    def _host_state(self, host: str) -> str:
+        base = self._url.format(host=host)
+        try:
+            if _get(f"{base}/preempted",
+                    self._timeout).strip().upper() == "TRUE":
+                return "preempted"
+            event = _get(f"{base}/maintenance-event",
+                         self._timeout).strip().upper()
+            if event.startswith(_TERMINAL_EVENTS):
+                return "terminating"
+            return "ok"
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            log.debug("metadata poll for %s failed: %s", host, e)
+            return "unreachable"
+
+    # -- HostDiscovery --------------------------------------------------
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        with self._lock:
+            hosts = list(self._hosts.items())
+            states = dict(zip(
+                (h for h, _ in hosts),
+                self._pool.map(self._host_state, (h for h, _ in hosts))))
+            available: Dict[str, int] = {}
+            for host, slots in hosts:
+                state = states[host]
+                if state == "unreachable":
+                    self._fail_counts[host] += 1
+                    # Kept for `grace` consecutive failed polls, dropped
+                    # on the (grace+1)-th.
+                    if self._fail_counts[host] <= self._grace:
+                        available[host] = slots   # grace period
+                    elif self._fail_counts[host] == self._grace + 1:
+                        log.warning(
+                            "host %s unreachable for %d polls; treating "
+                            "as gone", host, self._fail_counts[host])
+                    continue
+                self._fail_counts[host] = 0
+                if state == "ok":
+                    available[host] = slots
+                else:
+                    log.warning("host %s reports %s; removing from the "
+                                "membership", host, state)
+            return available
+
+
+# ---------------------------------------------------------------------------
+# Worker-side relay
+
+
+def serve_metadata_relay(port: int = DEFAULT_RELAY_PORT,
+                         metadata_base: str =
+                         "http://metadata.google.internal",
+                         bind: str = "0.0.0.0",
+                         block: bool = True):
+    """Serve this VM's metadata to the elastic driver.
+
+    Forwards ``GET`` requests for exactly the two health keys the driver
+    polls — ``instance/preempted`` and ``instance/maintenance-event`` — to
+    the VM-local metadata server (adding the mandatory ``Metadata-Flavor:
+    Google`` header) and returns the body verbatim.  Nothing else is
+    relayed: the metadata tree also serves the VM's service-account
+    tokens and SSH keys, and this is a health relay reachable from the
+    whole VPC, not an open proxy.
+
+    Returns the ``HTTPServer`` (already serving on a daemon thread when
+    ``block=False``).
+    """
+    import http.server
+
+    allowed = {
+        "/computeMetadata/v1/instance/preempted",
+        "/computeMetadata/v1/instance/maintenance-event",
+    }
+
+    class _Relay(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path.split("?", 1)[0] not in allowed:
+                self.send_error(
+                    404, "only preempted/maintenance-event are relayed")
+                return
+            try:
+                body = _get(metadata_base + self.path, timeout=2.0).encode()
+            except (urllib.error.URLError, OSError) as e:
+                self.send_error(502, f"metadata fetch failed: {e}")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # noqa: A003
+            log.debug("relay: " + fmt, *args)
+
+    server = http.server.ThreadingHTTPServer((bind, port), _Relay)
+    if block:
+        log.info("serving metadata relay on %s:%d", bind, port)
+        server.serve_forever()
+    else:
+        threading.Thread(target=server.serve_forever,
+                         name="tpu-metadata-relay", daemon=True).start()
+    return server
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Relay this VM's GCE metadata to the elastic driver")
+    ap.add_argument("--port", type=int, default=DEFAULT_RELAY_PORT)
+    ap.add_argument("--metadata-base",
+                    default="http://metadata.google.internal")
+    ns = ap.parse_args()
+    serve_metadata_relay(port=ns.port, metadata_base=ns.metadata_base)

@@ -78,6 +78,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--host-discovery", default=None,
+                   choices=["script", "tpu-metadata"],
+                   help="elastic discovery source: 'script' (use "
+                        "--host-discovery-script) or 'tpu-metadata' (poll "
+                        "GCE preemption/maintenance notices for the hosts "
+                        "in -H/--hostfile; see "
+                        "horovod_tpu.elastic.tpu_metadata)")
+    p.add_argument("--tpu-metadata-url", default=None,
+                   help="URL template for --host-discovery tpu-metadata "
+                        "with a {host} placeholder (default: the per-host "
+                        "relay on port 8677)")
     p.add_argument("--reset-limit", type=int, default=None)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command to run on every slot")
@@ -356,7 +367,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     if not command:
         print("hvdrun: no command given", file=sys.stderr)
         return 2
-    if args.host_discovery_script or (args.min_np is not None):
+    if args.host_discovery_script or args.host_discovery \
+            or (args.min_np is not None):
         try:
             from ..elastic.launcher import launch_elastic_job
         except ImportError as e:

@@ -1,0 +1,291 @@
+"""TPU-preemption discovery: metadata polling → elastic scale-down.
+
+Reference analog: the pluggable discovery family
+(``/root/reference/horovod/runner/elastic/discovery.py:130-163``) tested by
+``test/single/test_elastic_driver.py`` with mock discovery scripts.  Here
+the mock is a fake GCE metadata server (per-host preempted /
+maintenance-event keys), driving:
+
+- unit: state classification (ok / preempted / terminating / unreachable
+  grace) in :class:`TpuMetadataDiscovery`;
+- in-process: a preemption notice drives a scale-down epoch end-to-end
+  through the real :class:`ElasticDriver` (new slot table published,
+  removed identity gets rank −1);
+- subprocess: ``hvdrun --host-discovery tpu-metadata`` runs a real 2-proc
+  elastic job that survives a mid-run preemption at size 1.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic.tpu_metadata import TpuMetadataDiscovery
+from horovod_tpu.runner.hosts import HostInfo
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeMetadataServer:
+    """Per-host GCE instance metadata: GET /{host}/computeMetadata/v1/
+    instance/{key}.  Hosts marked down return 503 (unreachable-ish)."""
+
+    def __init__(self):
+        self.states = {}          # host -> {"preempted": .., "maintenance-event": ..}
+        self.down = set()
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                # {host}/computeMetadata/v1/instance/{key}
+                if len(parts) != 4 + 1 or parts[1] != "computeMetadata":
+                    self.send_error(404)
+                    return
+                host, key = parts[0], parts[-1]
+                if host in outer.down or host not in outer.states:
+                    self.send_error(503, "host gone")
+                    return
+                body = outer.states[host].get(key, "NONE").encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # noqa: A003
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), _Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url_template(self) -> str:
+        return (f"http://127.0.0.1:{self.port}/{{host}}"
+                "/computeMetadata/v1/instance")
+
+    def set_ok(self, host):
+        self.states[host] = {"preempted": "FALSE",
+                             "maintenance-event": "NONE"}
+
+    def preempt(self, host):
+        self.states[host]["preempted"] = "TRUE"
+
+    def maintenance(self, host, event):
+        self.states[host]["maintenance-event"] = event
+
+    def stop(self):
+        self._server.shutdown()
+
+
+@pytest.fixture()
+def meta():
+    server = FakeMetadataServer()
+    yield server
+    server.stop()
+
+
+def _discovery(meta, hosts=("a", "b"), **kw):
+    for h in hosts:
+        meta.set_ok(h)
+    return TpuMetadataDiscovery([HostInfo(h, 2) for h in hosts],
+                                url_template=meta.url_template, **kw)
+
+
+@pytest.mark.smoke
+def test_all_healthy_hosts_listed(meta):
+    disc = _discovery(meta)
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+
+
+@pytest.mark.smoke
+def test_preempted_host_dropped_immediately(meta):
+    disc = _discovery(meta)
+    meta.preempt("b")
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+
+
+@pytest.mark.smoke
+def test_terminal_maintenance_drops_but_migrate_does_not(meta):
+    disc = _discovery(meta)
+    meta.maintenance("a", "MIGRATE_ON_HOST_MAINTENANCE")
+    meta.maintenance("b", "TERMINATE_ON_HOST_MAINTENANCE")
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+
+
+@pytest.mark.smoke
+def test_unreachable_grace_then_removed(meta):
+    """Kept for exactly `unreachable_grace` consecutive failed polls,
+    dropped on the next one."""
+    disc = _discovery(meta, unreachable_grace=2)
+    meta.down.add("b")
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+    # recovery clears the strike counter
+    meta.down.discard("b")
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+
+
+@pytest.mark.smoke
+def test_url_template_requires_host_placeholder():
+    with pytest.raises(ValueError, match="{host}"):
+        TpuMetadataDiscovery([HostInfo("a", 1)],
+                             url_template="http://fixed:1/md")
+
+
+@pytest.mark.smoke
+def test_relay_proxies_only_metadata_paths(meta):
+    """The worker-side relay forwards /computeMetadata/ GETs to its local
+    metadata server and refuses everything else."""
+    import urllib.error
+    import urllib.request
+
+    from horovod_tpu.elastic.tpu_metadata import serve_metadata_relay
+
+    meta.set_ok("self")
+    relay = serve_metadata_relay(
+        port=0, metadata_base=f"http://127.0.0.1:{meta.port}/self",
+        bind="127.0.0.1", block=False)
+    try:
+        rport = relay.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{rport}/computeMetadata/v1/instance/preempted",
+            timeout=5).read()
+        assert body == b"FALSE"
+        # Anything beyond the two health keys is refused — the metadata
+        # tree also serves service-account tokens.
+        for path in ("/etc/passwd",
+                     "/computeMetadata/v1/instance/service-accounts/"
+                     "default/token",
+                     "/computeMetadata/v1/instance/?recursive=true"):
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}{path}", timeout=5)
+    finally:
+        relay.shutdown()
+
+
+def test_preemption_drives_scale_down_epoch_through_driver(meta):
+    """End-to-end through the real ElasticDriver: a preemption notice on
+    one host advances the membership epoch, republishes the slot table
+    with the survivor at size 1, and hands the removed identity rank −1."""
+    from horovod_tpu.elastic.discovery import HostManager
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    disc = _discovery(meta, hosts=("hostA", "hostB"))
+    server = RendezvousServer(bind_addr="127.0.0.1")
+    server.start()
+    spawned = []
+    driver = ElasticDriver(server, HostManager(disc), min_np=1, timeout=30)
+    try:
+        driver.start(lambda slot, epoch: spawned.append((slot, epoch)))
+        assert {s.hostname for s, _ in spawned} == {"hostA", "hostB"}
+        assert len(driver.current_slots) == 4  # 2 hosts x 2 slots
+        assert driver.epoch == 0
+
+        meta.preempt("hostB")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and driver.epoch == 0:
+            time.sleep(0.2)
+        assert driver.epoch >= 1, "preemption never advanced the epoch"
+        slots = driver.current_slots
+        assert {s.hostname for s in slots} == {"hostA"}
+        assert all(s.size == 2 for s in slots)
+
+        removed = json.loads(
+            server.get("rank_and_size", "hostB:0").decode())
+        assert removed["rank"] == -1, removed
+        survivor = json.loads(
+            server.get("rank_and_size", "hostA:0").decode())
+        assert survivor["size"] == 2 and survivor["rank"] >= 0
+    finally:
+        driver.stop()
+        server.stop()
+
+
+_ELASTIC_TRAIN = """
+import os, time
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0)
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 90:
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="g")
+        assert np.allclose(np.asarray(out), hvd.size()), out
+        print(f"BATCH {state.batch} rank={hvd.rank()} size={hvd.size()}",
+              flush=True)
+        state.batch += 1
+        state.commit()
+        time.sleep(0.15)
+
+train(state)
+print("ELASTIC_DONE", hvd.rank(), flush=True)
+hvd.shutdown()
+"""
+
+
+def test_hvdrun_tpu_metadata_preemption_end_to_end(meta, tmp_path):
+    """`hvdrun --host-discovery tpu-metadata`: a 2-host elastic job sees
+    one host preempted mid-run (via the fake metadata server) and
+    finishes at size 1 — the BASELINE config-#5 flow with metadata
+    notices instead of a discovery script."""
+    for h in ("localhost", "127.0.0.1"):
+        meta.set_ok(h)
+    train = tmp_path / "train.py"
+    train.write_text(_ELASTIC_TRAIN)
+    out_path = tmp_path / "stdout.log"
+    err_path = tmp_path / "stderr.log"
+    with open(out_path, "w") as of, open(err_path, "w") as ef:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "2", "--min-np", "1",
+             "-H", "localhost:1,127.0.0.1:1",
+             "--host-discovery", "tpu-metadata",
+             "--tpu-metadata-url", meta.url_template,
+             sys.executable, str(train)],
+            cwd=REPO_ROOT, text=True, stdout=of, stderr=ef)
+        try:
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if "size=2" in out_path.read_text():
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        "job exited early:\n" + out_path.read_text()
+                        + err_path.read_text())
+                time.sleep(0.5)
+            else:
+                raise AssertionError("never ran at size 2:\n"
+                                     + err_path.read_text())
+            meta.preempt("127.0.0.1")
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            raise AssertionError(
+                f"elastic job hung\nstdout:\n{out_path.read_text()}"
+                f"\nstderr:\n{err_path.read_text()}")
+    out, err = out_path.read_text(), err_path.read_text()
+    assert proc.returncode == 0, (out, err)
+    assert "ELASTIC_DONE" in out, (out, err)
+    assert "size=1" in out, "never recovered at reduced size"
