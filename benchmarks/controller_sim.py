@@ -70,6 +70,31 @@ def requests_for(t: int, rank: int):
             for i in range(t)]
 
 
+def _subtree(rank: int, size: int):
+    """All ranks in rank's binomial subtree (itself included)."""
+    from horovod_tpu.core.controller import tree_children
+
+    out = [rank]
+    for c in tree_children(rank, size):
+        out.extend(_subtree(c, size))
+    return out
+
+
+def _preload(mesh, ctrl, world: int, payloads: dict) -> None:
+    """Feed per-worker payloads to the coordinator in the shape its
+    fan-out topology expects: direct messages for the star, per-child
+    subtree bundles for the tree (what interior ranks would relay)."""
+    if ctrl.fanout_topology == "tree":
+        from horovod_tpu.core.controller import _encode_bundle, tree_children
+
+        for child in tree_children(0, world):
+            mesh.preload(child, _encode_bundle(
+                [(r, payloads[r]) for r in _subtree(child, world)]))
+    else:
+        for w, p in payloads.items():
+            mesh.preload(w, p)
+
+
 def run_case(world: int, tensors: int, cycles: int) -> dict:
     topo = ProcessTopology(rank=0, size=world, local_rank=0,
                            local_size=world, cross_rank=0, cross_size=1)
@@ -81,8 +106,7 @@ def run_case(world: int, tensors: int, cycles: int) -> dict:
         w: RequestList(requests=requests_for(tensors, w)).to_bytes()
         for w in range(1, world)
     }
-    for w, p in cold_payload.items():
-        mesh.preload(w, p)
+    _preload(mesh, ctrl, world, cold_payload)
     t0 = time.perf_counter()
     rlist = ctrl.compute_response_list(requests_for(tensors, 0))
     cold_ms = (time.perf_counter() - t0) * 1e3
@@ -101,10 +125,10 @@ def run_case(world: int, tensors: int, cycles: int) -> dict:
         mask |= 1 << b
     mask_bytes = mask.to_bytes((mask.bit_length() + 7) // 8, "little")
     reps = []
+    hot_payload = RequestList(requests=[], cache_mask=mask_bytes).to_bytes()
     for _ in range(cycles):
-        for w in range(1, world):
-            mesh.preload(w, RequestList(requests=[],
-                                        cache_mask=mask_bytes).to_bytes())
+        _preload(mesh, ctrl, world,
+                 {w: hot_payload for w in range(1, world)})
         t0 = time.perf_counter()
         rl = ctrl.compute_response_list(requests_for(tensors, 0))
         reps.append((time.perf_counter() - t0) * 1e3)
@@ -114,6 +138,7 @@ def run_case(world: int, tensors: int, cycles: int) -> dict:
     return {
         "metric": "coordinator_cycle_cost",
         "world_size": world,
+        "fanout_topology": ctrl.fanout_topology,
         "tensors": tensors,
         "fused_responses": n_responses,
         "cold_cycle_ms": round(cold_ms, 3),

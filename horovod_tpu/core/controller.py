@@ -17,10 +17,14 @@ Role of the reference's ``horovod/common/controller.cc:97-525``
   5. every rank executes the ResponseList in identical order.
 
 The reference implements step 2/4 with MPI gather/bcast or gloo
-allgatherv/broadcast; ours run over the self-contained ``TcpMesh``
-(star topology: sequential recv at rank 0, sequential send out — adequate to
-hundreds of ranks for the small control messages involved, and trivially
-replaceable by a tree).
+allgatherv/broadcast (tree-structured inside those libraries); ours run
+over the self-contained ``TcpMesh`` with a choice of fan-out
+(``HOROVOD_CONTROLLER_TOPOLOGY=star|tree|auto``): the star does a
+sequential recv/send loop at rank 0 (lowest latency at small P), the
+binomial tree relays gather bundles / response broadcasts through
+O(log P) levels (rank-0 cost stops growing linearly with P).  ``auto``
+switches at ``TREE_TOPOLOGY_THRESHOLD``, set by
+``benchmarks/controller_bench.py`` measurement.
 
 Also here: Join bookkeeping (zero-substitution for finished ranks) and the
 stall inspector hook.
@@ -49,6 +53,53 @@ log = get_logger("horovod_tpu.controller")
 
 JOIN_TENSOR_NAME = "__join__"
 BARRIER_TENSOR_NAME = "__barrier__"
+
+#: World size at which ``HOROVOD_CONTROLLER_TOPOLOGY=auto`` switches from
+#: the star to the binomial tree.  Set by measurement
+#: (``benchmarks/controller_bench.py``): the star's O(P) serial recv/send
+#: at the coordinator crosses the tree's O(log P) depth around this size
+#: for control-plane-sized messages.
+TREE_TOPOLOGY_THRESHOLD = 64
+
+
+def tree_parent(rank: int) -> int:
+    """Binomial-tree parent rooted at 0: clear the lowest set bit
+    (the role MPI's internal gather/bcast trees play for the reference,
+    ``mpi_controller.cc:108-162``)."""
+    return rank & (rank - 1)
+
+
+def tree_children(rank: int, size: int) -> List[int]:
+    """Binomial-tree children of ``rank`` in a ``size``-rank job: rank+2^k
+    for every power of two below rank's lowest set bit (all powers for
+    the root), capped by size."""
+    low = (rank & -rank) if rank else size
+    children, bit = [], 1
+    while bit < low and rank + bit < size:
+        children.append(rank + bit)
+        bit <<= 1
+    return children
+
+
+def _encode_bundle(entries: List[tuple]) -> bytes:
+    """[(rank, payload)] → wire bytes for the up-tree gather."""
+    parts = [len(entries).to_bytes(4, "little")]
+    for rank, payload in entries:
+        parts.append(rank.to_bytes(4, "little"))
+        parts.append(len(payload).to_bytes(4, "little"))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def _decode_bundle(data: bytes) -> List[tuple]:
+    n = int.from_bytes(data[:4], "little")
+    entries, off = [], 4
+    for _ in range(n):
+        rank = int.from_bytes(data[off:off + 4], "little")
+        ln = int.from_bytes(data[off + 4:off + 8], "little")
+        entries.append((rank, data[off + 8:off + 8 + ln]))
+        off += 8 + ln
+    return entries
 
 
 @dataclass
@@ -97,6 +148,23 @@ class Controller:
         # Tensors completed by a stall-time bit→table conversion (after this
         # cycle's responses were already built); delivered next cycle.
         self._stall_completed: List[str] = []
+        # Negotiation fan-out topology: the star does O(P) serial
+        # recv/send at rank 0; the binomial tree spreads that over
+        # O(log P) levels (every rank relays its subtree's bundles).
+        # "auto" picks by world size at the measured crossover.
+        import os as _os
+
+        topo_env = _os.environ.get("HOROVOD_CONTROLLER_TOPOLOGY", "auto") \
+            .strip().lower()
+        if topo_env not in ("auto", "star", "tree"):
+            raise ValueError(
+                f"HOROVOD_CONTROLLER_TOPOLOGY={topo_env!r}: expected "
+                "auto|star|tree")
+        if topo_env == "auto":
+            topo_env = "tree" if topology.size >= TREE_TOPOLOGY_THRESHOLD \
+                else "star"
+        # A 2-rank tree degenerates to the star exactly.
+        self.fanout_topology = "star" if topology.size <= 2 else topo_env
         # FIFO completion order like the reference: responses are emitted in
         # the order tensors *complete*, which is deterministic because only
         # the coordinator decides it.
@@ -115,8 +183,10 @@ class Controller:
             return self._coordinator_round(requests, should_shutdown)
         return self._worker_round(requests, should_shutdown)
 
-    def _worker_round(self, requests: List[Request],
-                      should_shutdown: bool) -> ResponseList:
+    def _worker_payload(self, requests: List[Request],
+                        should_shutdown: bool) -> bytes:
+        """This rank's RequestList for the cycle (cache-mirror hits become
+        mask bits)."""
         hits: List[int] = []
         if self._mirror is not None:
             misses = []
@@ -132,17 +202,66 @@ class Controller:
         mask = 0
         for bit in hits:
             mask |= 1 << bit
-        payload = RequestList(
+        return RequestList(
             requests=requests, shutdown=should_shutdown,
             cache_mask=mask.to_bytes((mask.bit_length() + 7) // 8,
                                      "little")).to_bytes()
-        self.mesh.send(0, payload)
-        rlist = ResponseList.from_bytes(self.mesh.recv(0))
+
+    def _apply_response_list(self, rlist: ResponseList) -> ResponseList:
         if self._mirror is not None:
             self._mirror.apply(rlist.cache_assignments, rlist.evicted_bits)
         if rlist.tuned_params is not None:
             self.fusion_threshold = rlist.tuned_params[0]
         return rlist
+
+    def _worker_round(self, requests: List[Request],
+                      should_shutdown: bool) -> ResponseList:
+        payload = self._worker_payload(requests, should_shutdown)
+        if self.fanout_topology == "tree":
+            return self._worker_round_tree(payload)
+        self.mesh.send(0, payload)
+        rlist = ResponseList.from_bytes(self.mesh.recv(0))
+        return self._apply_response_list(rlist)
+
+    def _worker_round_tree(self, payload: bytes) -> ResponseList:
+        """Binomial-tree flavor: relay the subtree's gather bundles up to
+        the parent, then relay the response broadcast down to the
+        children.  Depth is O(log P) versus the star's O(P) serial
+        coordinator loop; interior ranks do O(subtree) byte copies but
+        those run in parallel across the tree."""
+        rank, size = self.topo.rank, self.topo.size
+        entries = [(rank, payload)]
+        for child in tree_children(rank, size):
+            entries.extend(_decode_bundle(self.mesh.recv(child)))
+        self.mesh.send(tree_parent(rank), _encode_bundle(entries))
+        resp_payload = self.mesh.recv(tree_parent(rank))
+        for child in tree_children(rank, size):
+            self.mesh.send(child, resp_payload)
+        return self._apply_response_list(
+            ResponseList.from_bytes(resp_payload))
+
+    def _gather_request_lists(self):
+        """Yield every other rank's (rank, RequestList) for this cycle, in
+        deterministic rank order for the tree (the star's serial loop is
+        ordered by construction)."""
+        if self.fanout_topology == "tree":
+            entries: List[tuple] = []
+            for child in tree_children(0, self.topo.size):
+                entries.extend(_decode_bundle(self.mesh.recv(child)))
+            entries.sort()
+            for rank, payload in entries:
+                yield rank, RequestList.from_bytes(payload)
+        else:
+            for worker in range(1, self.topo.size):
+                yield worker, RequestList.from_bytes(self.mesh.recv(worker))
+
+    def _broadcast_response_payload(self, payload: bytes) -> None:
+        if self.fanout_topology == "tree":
+            for child in tree_children(0, self.topo.size):
+                self.mesh.send(child, payload)
+        else:
+            for worker in range(1, self.topo.size):
+                self.mesh.send(worker, payload)
 
     def _coordinator_round(self, own_requests: List[Request],
                            should_shutdown: bool) -> ResponseList:
@@ -162,8 +281,7 @@ class Controller:
                 self.cache_hit_count += 1
             elif self._increment(req):
                 ready.append(req.tensor_name)
-        for worker in range(1, self.topo.size):
-            rl = RequestList.from_bytes(self.mesh.recv(worker))
+        for worker, rl in self._gather_request_lists():
             should_shutdown = should_shutdown or rl.shutdown
             if rl.cache_mask:
                 pending[worker] = pending.get(worker, 0) | int.from_bytes(
@@ -201,8 +319,7 @@ class Controller:
                              evicted_bits=self._cycle_evictions,
                              tuned_params=tuned)
         payload = rlist.to_bytes()
-        for worker in range(1, self.topo.size):
-            self.mesh.send(worker, payload)
+        self._broadcast_response_payload(payload)
         return rlist
 
     def _mask_round(self, pending: Dict[int, int]) -> List[Response]:

@@ -72,6 +72,7 @@ FLAKY_SIGNATURES = (
     "background loop died",
     "could not connect to rank",
     "rendezvous wait timed out",
+    "tcp mesh accept failed",
 )
 _FLAKY_SIGNATURES = FLAKY_SIGNATURES  # back-compat alias
 

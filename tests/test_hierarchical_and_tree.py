@@ -76,3 +76,60 @@ print("BF16_OK", rank, flush=True)
 """, timeout=240)
     for r, o in enumerate(out):
         assert f"BF16_OK {r}" in o
+
+# ---------------------------------------------------------------------------
+# Controller negotiation fan-out: binomial tree vs star
+# (HOROVOD_CONTROLLER_TOPOLOGY; reference role: MPI gather/bcast are
+# tree-structured internally, mpi_controller.cc:108-162)
+
+
+@pytest.mark.smoke
+def test_binomial_tree_shape():
+    from horovod_tpu.core.controller import tree_children, tree_parent
+
+    for size in (2, 3, 4, 5, 7, 8, 13, 64, 256):
+        seen = {0}
+        for rank in range(1, size):
+            parent = tree_parent(rank)
+            assert 0 <= parent < rank  # acyclic, rooted at 0
+            assert rank in tree_children(parent, size), (rank, parent)
+            seen.add(rank)
+        # children lists are disjoint and cover every non-root rank
+        all_children = [c for r in range(size)
+                        for c in tree_children(r, size)]
+        assert sorted(all_children) == sorted(seen - {0})
+        # depth is O(log P): number of up-hops from any rank
+        for rank in range(size):
+            hops, r = 0, rank
+            while r:
+                r = tree_parent(r)
+                hops += 1
+            assert hops <= size.bit_length(), (size, rank, hops)
+
+
+@pytest.mark.smoke
+def test_gather_bundle_roundtrip():
+    from horovod_tpu.core.controller import _decode_bundle, _encode_bundle
+
+    entries = [(3, b"abc"), (1, b""), (7, bytes(range(256)))]
+    assert _decode_bundle(_encode_bundle(entries)) == entries
+    assert _decode_bundle(_encode_bundle([])) == []
+
+
+@pytest.mark.parametrize("n", [4, 5])
+def test_tree_controller_collectives_end_to_end(n):
+    """Full eager collectives with the tree fan-out, at a power-of-2 and a
+    ragged size: allreduce + broadcast + the cache fast path (steady-state
+    cycles ride the mask round through relayed bundles)."""
+    out = run_distributed(n, """
+v = np.full(8, float(rank + 1), np.float32)
+for step in range(12):   # enough cycles to enter the cache fast path
+    s = hvd.allreduce(v, op=hvd.Sum, name="tree.sum")
+    assert np.allclose(np.asarray(s), sum(range(1, size + 1))), s
+b = hvd.broadcast(np.full(4, float(rank), np.float32), root_rank=2,
+                  name="tree.bcast")
+assert np.allclose(np.asarray(b), 2.0), b
+print("TREE_OK", rank, flush=True)
+""", timeout=240, extra_env={"HOROVOD_CONTROLLER_TOPOLOGY": "tree"})
+    for r, o in enumerate(out):
+        assert f"TREE_OK {r}" in o
