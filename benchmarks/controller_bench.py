@@ -130,9 +130,8 @@ def compare_topologies(world_sizes, tensors: int) -> list:
         if world <= 2:
             # Controller forces the star at size <= 2 (a 2-rank tree IS
             # the star); a "tree" row here would just be star noise.
-            print(json.dumps({"world_size": world,
-                              "skipped": "tree degenerates to star"}),
-                  flush=True)
+            out.append({"world_size": world,
+                        "skipped": "tree degenerates to star"})
             continue
         depth = max(1, math.ceil(math.log2(world)))
         star_cpu = _coordinator_cpu_ms(world, tensors, "star")
@@ -193,7 +192,7 @@ def main() -> int:
             # (accept timeouts under load) — retry via the suite's shared
             # infra-signature gate (tests/helpers.py), not a divergent
             # copy of it.
-            from tests.helpers import infra_retryable
+            from tests.helpers import infra_retryable, retry_backoff
 
             for attempt in range(3):
                 try:
@@ -203,9 +202,7 @@ def main() -> int:
                 except Exception as e:  # noqa: BLE001
                     if attempt == 2 or not infra_retryable(e):
                         raise
-                    import time as _t
-
-                    _t.sleep(5 * (attempt + 1))
+                    retry_backoff(attempt + 1)
             rec = {
                 "metric": "negotiation_latency",
                 "world_size": np_,

@@ -131,7 +131,18 @@ class ParameterManager:
         self._best: Tuple[float, Tuple[int, float]] = (
             -1.0, (initial_fusion_bytes, initial_cycle_ms))
         self._done = False
+        # Per-sample CSV artifact (reference HOROVOD_AUTOTUNE_LOG,
+        # ``parameter_manager.h:112`` / ``.cc:81,266-272``): header naming
+        # the tunables, one row per sample, and a final ``best`` row when
+        # the tuner settles.  Our tunable set is (cycle_time_ms,
+        # fusion_threshold_mb) — the reference's categorical knobs
+        # (hierarchical ops, cache on/off) are structural here, not tuned.
         self._log = open(log_path, "w") if log_path else None
+        if self._log:
+            self._log.write(
+                "sample,cycle_time_ms,tensor_fusion_threshold_mb,"
+                "score_bytes_per_sec\n")
+            self._log.flush()
 
     @property
     def fusion_threshold_bytes(self) -> int:
@@ -143,9 +154,21 @@ class ParameterManager:
 
     def update(self, nbytes: int) -> Optional[Tuple[int, float]]:
         """Record one negotiation cycle's reduced byte volume; returns new
-        (fusion_bytes, cycle_ms) when the tuner moves, else None."""
-        if not self.enabled or self._done:
+        (fusion_bytes, cycle_ms) when the tuner moves, else None.
+
+        Idle cycles (nothing reduced) do not advance the sample: the
+        reference steps samples by per-tensor reduction counts
+        (``parameter_manager.cc:148-159``), so only cycles that actually
+        moved bytes count toward ``steps_per_sample`` — otherwise the
+        background loop's empty ticks close zero-byte samples and the
+        tuner optimizes noise."""
+        if not self.enabled or self._done or nbytes <= 0:
             return None
+        if self._step_in_sample == 0:
+            # First counted step: restart the clock so an idle gap
+            # between samples (eval pause, checkpoint) is not billed to
+            # this sample's bytes/sec.
+            self._sample_start = time.monotonic()
         self._bytes_in_sample += nbytes
         self._step_in_sample += 1
         if self._step_in_sample < self.steps_per_sample:
@@ -156,8 +179,8 @@ class ParameterManager:
         params = (self._fusion_bytes / (1024.0 * 1024.0), self._cycle_ms)
         self._samples_seen += 1
         if self._log:
-            self._log.write(f"{self._samples_seen},{params[0]:.2f},"
-                            f"{params[1]:.2f},{score:.0f}\n")
+            self._log.write(f"{self._samples_seen},{params[1]:.2f},"
+                            f"{params[0]:.2f},{score:.0f}\n")
             self._log.flush()
         if self._samples_seen > self.warmup_samples:
             self._bo.observe(params, score)
@@ -169,6 +192,11 @@ class ParameterManager:
             self._fusion_bytes, self._cycle_ms = self._best[1]
             self._done = True
             if self._log:
+                # Final row mirrors the reference's LogBestParameters.
+                self._log.write(
+                    f"best,{self._cycle_ms:.2f},"
+                    f"{self._fusion_bytes / (1024.0 * 1024.0):.2f},"
+                    f"{max(self._best[0], 0):.0f}\n")
                 self._log.close()
                 self._log = None
         else:

@@ -27,6 +27,44 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 
+#: Row cap for the store-less (driver-collect) fit path; 0 disables.
+INLINE_MAX_ROWS_ENV = "HOROVOD_SPARK_INLINE_MAX_ROWS"
+DEFAULT_INLINE_MAX_ROWS = 100_000
+
+
+def guard_inline_collect(df) -> None:
+    """Guardrail for fitting a distributed DataFrame WITHOUT a store.
+
+    The store-less path collects the whole DataFrame onto the driver —
+    fine for toys, an OOM for real datasets (the reference never does
+    this: its estimators always stage through a ``Store``,
+    ``spark/common/store.py:32-153``).  Warn loudly, and refuse outright
+    above ``HOROVOD_SPARK_INLINE_MAX_ROWS`` rows (default 100k; 0
+    disables the cap).  Driver-local inputs (pandas / arrays) pass
+    through untouched.
+    """
+    if not (hasattr(df, "rdd") and hasattr(df, "count")):
+        return  # already driver-local
+    from ..common.logging_util import get_logger
+
+    log = get_logger("horovod_tpu.spark")
+    cap = int(os.environ.get(INLINE_MAX_ROWS_ENV, DEFAULT_INLINE_MAX_ROWS))
+    log.warning(
+        "no store= configured: fit() will collect the full DataFrame "
+        "onto the driver. Pass store= (LocalStore/...) to keep the "
+        "dataset partitioned on the executors.")
+    if cap > 0:
+        # limit(cap+1).count() lets Spark stop scanning after cap+1 rows
+        # instead of counting the whole dataset just to check the cap.
+        probe = df.limit(cap + 1) if hasattr(df, "limit") else df
+        if probe.count() > cap:
+            raise ValueError(
+                f"store-less fit would collect more than {cap} rows onto "
+                f"the driver ({INLINE_MAX_ROWS_ENV}={cap}). Pass store= "
+                "to use the partitioned data plane, or raise/disable the "
+                f"cap via {INLINE_MAX_ROWS_ENV} if this is intentional.")
+
+
 class Store:
     """Checkpoint/artifact store (reference ``store.py:32-153``)."""
 

@@ -136,6 +136,9 @@ class TorchEstimator:
                 validation=self.validation)
             data = ("store", manifest)
         else:
+            from .common import guard_inline_collect
+
+            guard_inline_collect(df)
             x, y = extract_arrays(df, self.feature_cols, self.label_cols)
             n_proc = self.num_proc or int(
                 getattr(sc, "defaultParallelism", 0) or 0)

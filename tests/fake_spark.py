@@ -83,3 +83,13 @@ class FakeDataFrame:
     def rdd(self) -> FakeRDD:
         chunks = [self._rows[i::self._n] for i in range(self._n)]
         return FakeRDD(chunks)
+
+    def count(self) -> int:
+        return len(self._rows)
+
+    def select(self, *cols: str) -> "FakeDataFrame":
+        return FakeDataFrame([{c: r[c] for c in cols} for r in self._rows],
+                             num_partitions=self._n)
+
+    def collect(self) -> List[dict]:
+        return list(self._rows)

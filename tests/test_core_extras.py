@@ -107,6 +107,75 @@ class TestParameterManager:
         assert pm.update(nbytes=1 << 20) is None
 
 
+
+    def test_idle_cycles_do_not_advance_samples(self, tmp_path):
+        """The background loop ticks every cycle_time_ms even when idle;
+        zero-byte cycles must not close samples (else the tuner scores
+        noise — reference parameter_manager.cc:148-159 steps by actual
+        reductions)."""
+        pm = ParameterManager(enabled=True, warmup_samples=0,
+                              steps_per_sample=2, max_samples=2)
+        for _ in range(50):
+            assert pm.update(nbytes=0) is None
+        assert pm._samples_seen == 0
+        pm.update(nbytes=100)
+        for _ in range(50):
+            pm.update(nbytes=0)
+        assert pm._samples_seen == 0      # still mid-sample
+        assert pm.update(nbytes=100) is not None   # closes the sample
+        assert pm._samples_seen == 1
+
+    def test_idle_gap_not_billed_to_sample_score(self, monkeypatch):
+        """An idle gap BETWEEN samples must not inflate the next sample's
+        elapsed time: the clock restarts on the first counted step."""
+        from horovod_tpu.core import parameter_manager as pm_mod
+
+        now = [0.0]
+        monkeypatch.setattr(pm_mod.time, "monotonic", lambda: now[0])
+        pm = ParameterManager(enabled=True, warmup_samples=0,
+                              steps_per_sample=2, max_samples=8)
+        now[0] = 100.0                 # long idle gap after init
+        for _ in range(5):
+            pm.update(nbytes=0)        # idle ticks during the gap
+        pm.update(nbytes=1000)         # first counted step: clock restarts
+        now[0] = 101.0
+        pm.update(nbytes=1000)         # closes the sample after 1s
+        # score must be 2000 bytes / 1s, not 2000/101s
+        assert pm._bo._ys, "sample was not observed"
+        assert abs(pm._bo._ys[-1] - 2000.0) < 1.0, pm._bo._ys
+
+    def test_autotune_log_csv_artifact(self, tmp_path):
+        """--autotune-log-file emits the per-sample CSV record family the
+        reference writes via HOROVOD_AUTOTUNE_LOG
+        (parameter_manager.h:112, .cc:81,266-291): a header naming the
+        tunables, one row per sample with (params, score), and a final
+        best row when the tuner settles."""
+        log = tmp_path / "autotune.csv"
+        pm = ParameterManager(enabled=True, warmup_samples=1,
+                              steps_per_sample=2, max_samples=3,
+                              log_path=str(log))
+        for _ in range(40):
+            pm.update(nbytes=1 << 20)
+        assert pm._done
+        lines = log.read_text().strip().splitlines()
+        assert lines[0] == ("sample,cycle_time_ms,"
+                            "tensor_fusion_threshold_mb,score_bytes_per_sec")
+        samples, best = lines[1:-1], lines[-1]
+        assert len(samples) == 4  # warmup + max_samples
+        for i, row in enumerate(samples):
+            idx, cycle, fusion_mb, score = row.split(",")
+            assert int(idx) == i + 1
+            assert 0.0 < float(cycle) <= 50.0
+            assert float(fusion_mb) >= 0.0
+            assert float(score) > 0.0
+        b0, bcycle, bfusion, bscore = best.split(",")
+        assert b0 == "best"
+        # the settled params are what the manager now reports
+        assert abs(float(bcycle) - pm.cycle_time_ms) < 0.01
+        assert abs(float(bfusion)
+                   - pm.fusion_threshold_bytes / 1048576.0) < 0.01
+
+
 def test_cache_steady_state_hits_and_correctness():
     """Same tensor allreduced across many steps: later steps ride the cache
     bit path and results stay exact."""

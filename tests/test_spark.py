@@ -4,7 +4,7 @@ local-mode ``test_spark.py`` strategy minus the pyspark dependency."""
 
 import pytest
 
-from .fake_spark import FakeSparkContext
+from .fake_spark import FakeDataFrame, FakeSparkContext
 
 
 def _train_fn(mult):
@@ -316,3 +316,82 @@ def test_read_shards_skewed_and_scarce(tmp_path):
     m2 = {"train": parts[1:], "train_rows": 10}
     lens = {r: len(read_shards(store, m2, r, 4)[0]) for r in range(4)}
     assert set(lens.values()) == {3}, lens
+
+
+@pytest.mark.smoke
+class TestInlineCollectGuardrail:
+    """Store-less fit guardrail (reference always stages through a Store,
+    spark/common/store.py:32-153): collecting a distributed DataFrame on
+    the driver warns loudly and refuses above a row cap."""
+
+    @staticmethod
+    def _capture_warnings():
+        """The horovod_tpu logger does not propagate to the global root
+        (logging_util sets propagate=False), so caplog cannot see it;
+        attach a list handler directly."""
+        import logging
+
+        records = []
+
+        class _H(logging.Handler):
+            def emit(self, rec):
+                records.append(rec.getMessage())
+
+        handler = _H(level=logging.WARNING)
+        logging.getLogger("horovod_tpu.spark").addHandler(handler)
+        return records, handler
+
+    def test_driver_local_inputs_pass_silently(self):
+        import logging
+
+        from horovod_tpu.spark.common import guard_inline_collect
+
+        records, handler = self._capture_warnings()
+        try:
+            guard_inline_collect(([1, 2], [3, 4]))       # arrays
+        finally:
+            logging.getLogger("horovod_tpu.spark").removeHandler(handler)
+        assert not records
+
+    def test_spark_df_warns_below_cap(self):
+        import logging
+
+        from horovod_tpu.spark.common import guard_inline_collect
+
+        records, handler = self._capture_warnings()
+        try:
+            df = FakeDataFrame([{"x": i} for i in range(10)])
+            guard_inline_collect(df)
+        finally:
+            logging.getLogger("horovod_tpu.spark").removeHandler(handler)
+        assert any("collect the full DataFrame" in m and "store=" in m
+                   for m in records), records
+
+    def test_spark_df_refuses_above_cap(self, monkeypatch):
+        from horovod_tpu.spark.common import guard_inline_collect
+
+        monkeypatch.setenv("HOROVOD_SPARK_INLINE_MAX_ROWS", "5")
+        df = FakeDataFrame([{"x": i} for i in range(6)])
+        with pytest.raises(ValueError, match="store-less fit"):
+            guard_inline_collect(df)
+
+    def test_cap_disabled_by_zero(self, monkeypatch):
+        from horovod_tpu.spark.common import guard_inline_collect
+
+        monkeypatch.setenv("HOROVOD_SPARK_INLINE_MAX_ROWS", "0")
+        df = FakeDataFrame([{"x": i} for i in range(10_000)])
+        guard_inline_collect(df)   # warns but does not raise
+
+    def test_keras_fit_guarded(self, monkeypatch):
+        """The estimator's store-less fit path actually calls the guard."""
+        import horovod_tpu.spark.keras as hk
+
+        monkeypatch.setenv("HOROVOD_SPARK_INLINE_MAX_ROWS", "3")
+        est = hk.KerasEstimator.__new__(hk.KerasEstimator)
+        est.store = None
+        est.sc = FakeSparkContext()
+        est.feature_cols, est.label_cols = ["x"], ["y"]
+        est.num_proc = None
+        df = FakeDataFrame([{"x": float(i), "y": 0.0} for i in range(10)])
+        with pytest.raises(ValueError, match="store-less fit"):
+            hk.KerasEstimator.fit(est, df)

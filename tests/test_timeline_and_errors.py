@@ -123,3 +123,70 @@ print("DONE", rank, flush=True)
                               "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "3",
                           })
     assert "STALL_ABORT_OK" in out[0], out[0]
+
+
+def test_duplicate_inflight_name_raises_to_caller_table_path():
+    """A second enqueue of an in-flight name must surface
+    DuplicateNameError to the CALLER (reference delivers
+    DUPLICATE_NAME_ERROR to the callback, common.h:164-167) — here on the
+    cold table path, where the first negotiation is still pending because
+    the peer has not submitted yet."""
+    out = run_distributed(2, """
+import time
+from horovod_tpu.common.exceptions import DuplicateNameError
+from horovod_tpu.frameworks.jax import ops
+
+if rank == 0:
+    h = ops.allreduce_async(np.ones(4, np.float32), op=hvd.Sum, name="dup")
+    try:
+        ops.allreduce_async(np.ones(4, np.float32), op=hvd.Sum, name="dup")
+        print("DUP_NOT_RAISED", flush=True)
+    except DuplicateNameError:
+        print("DUP_TABLE_OK", flush=True)
+    out = ops.synchronize(h)       # first op still completes cleanly
+    assert np.allclose(np.asarray(out), 2.0), out
+else:
+    time.sleep(2)                  # keep rank 0's first op in flight
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="dup")
+    assert np.allclose(np.asarray(out), 2.0), out
+print("DONE", rank, flush=True)
+""", timeout=180)
+    assert "DUP_TABLE_OK" in out[0], out[0]
+    assert "DUP_NOT_RAISED" not in out[0]
+
+
+def test_duplicate_inflight_name_raises_to_caller_mask_path():
+    """Same contract on the steady-state mask fast path: after enough
+    rounds for the name's negotiation to ride cache bits, a resubmission
+    racing the in-flight op must still raise to the caller — and the
+    runtime must keep working for that name afterwards."""
+    out = run_distributed(2, """
+import time
+from horovod_tpu.common.exceptions import DuplicateNameError
+from horovod_tpu.frameworks.jax import ops
+
+for _ in range(6):                 # reach the cache/mask fast path
+    hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="dup")
+
+if rank == 0:
+    h = ops.allreduce_async(np.ones(4, np.float32), op=hvd.Sum, name="dup")
+    try:
+        ops.allreduce_async(np.ones(4, np.float32), op=hvd.Sum, name="dup")
+        print("DUP_NOT_RAISED", flush=True)
+    except DuplicateNameError:
+        print("DUP_MASK_OK", flush=True)
+    out = ops.synchronize(h)
+    assert np.allclose(np.asarray(out), 2.0), out
+else:
+    time.sleep(2)
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="dup")
+    assert np.allclose(np.asarray(out), 2.0), out
+
+# the name stays usable after the rejected resubmission
+final = hvd.allreduce(np.full(4, float(rank), np.float32), op=hvd.Sum,
+                      name="dup")
+assert np.allclose(np.asarray(final), 1.0), final
+print("DONE", rank, flush=True)
+""", timeout=180)
+    assert "DUP_MASK_OK" in out[0], out[0]
+    assert "DUP_NOT_RAISED" not in out[0]
