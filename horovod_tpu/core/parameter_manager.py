@@ -206,5 +206,5 @@ class ParameterManager:
 
         self._step_in_sample = 0
         self._bytes_in_sample = 0
-        self._sample_start = time.monotonic()
+        # (the sample clock restarts on the next counted step, not here)
         return (self._fusion_bytes, self._cycle_ms)

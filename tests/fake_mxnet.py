@@ -1,0 +1,152 @@
+"""Minimal mxnet stand-in with an ASYNC dependency engine.
+
+The real binding target (reference ``mxnet/mpi_ops.cc:182-191``) pushes
+collectives into MXNet's engine with read/write variable dependencies so
+they serialize with surrounding NDArray ops.  Our bridge instead relies on
+the two sync points every NDArray exposes — ``asnumpy()`` waits for pending
+writes, in-place assignment enqueues a write — so ordering holds under ANY
+legal engine schedule.  This fake proves that against an actually-async
+engine: every NDArray op is deferred onto a single worker thread (FIFO is
+a conservative legal schedule of the dependency engine) and only
+``asnumpy``/``wait_to_read`` synchronize.  A bridge that assumed eager
+execution would read stale buffers here.
+
+Injected via ``sys.modules["mxnet"]`` by tests; shaped like the small
+slice of the mxnet API the binding touches (``mx.nd.array/ones/zeros``,
+NDArray arithmetic, ``context``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class _Engine:
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fake-mxnet-engine")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            fn = self._q.get()
+            try:
+                fn()
+            finally:
+                self._q.task_done()
+
+    def push(self, fn):
+        self._q.put(fn)
+
+    def wait_all(self):
+        self._q.join()
+
+
+ENGINE = _Engine()
+
+
+class Context:
+    def __init__(self, kind: str = "cpu", index: int = 0):
+        self.kind, self.index = kind, index
+
+    def __repr__(self):
+        return f"{self.kind}({self.index})"
+
+
+class NDArray:
+    def __init__(self, data, ctx: Context | None = None):
+        self._data = np.array(data, dtype=np.float32, copy=True)
+        self.context = ctx or Context()
+
+    # -- sync points (the only ones the bridge may rely on) -------------
+    def wait_to_read(self):
+        ENGINE.wait_all()
+
+    def asnumpy(self) -> np.ndarray:
+        self.wait_to_read()
+        return self._data.copy()
+
+    # -- deferred ops ----------------------------------------------------
+    @property
+    def shape(self):
+        ENGINE.wait_all()
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    def __setitem__(self, key, value):
+        src = value._data if isinstance(value, NDArray) else np.asarray(value)
+
+        def run():
+            if isinstance(value, NDArray):
+                self._data[key] = value._data  # read dep resolved in-order
+            else:
+                self._data[key] = src
+
+        ENGINE.push(run)
+
+    def _inplace(self, other, op):
+        o = other
+
+        def run():
+            rhs = o._data if isinstance(o, NDArray) else o
+            op(self._data, rhs)
+
+        ENGINE.push(run)
+        return self
+
+    def __imul__(self, other):
+        return self._inplace(other, lambda a, b: a.__imul__(b))
+
+    def __iadd__(self, other):
+        return self._inplace(other, lambda a, b: a.__iadd__(b))
+
+    def __isub__(self, other):
+        return self._inplace(other, lambda a, b: a.__isub__(b))
+
+    def _binary(self, other, op):
+        out = NDArray(np.zeros_like(self._data), self.context)
+        o = other
+
+        def run():
+            rhs = o._data if isinstance(o, NDArray) else o
+            out._data = op(self._data, rhs)
+
+        ENGINE.push(run)
+        return out
+
+    def __mul__(self, other):
+        return self._binary(other, lambda a, b: a * b)
+
+    def __add__(self, other):
+        return self._binary(other, lambda a, b: a + b)
+
+    def sum(self):
+        return self._binary(0.0, lambda a, _: np.asarray(a.sum()))
+
+
+class _ND:
+    NDArray = NDArray
+
+    @staticmethod
+    def array(data, ctx=None, **_kw):
+        return NDArray(np.asarray(data), ctx)
+
+    @staticmethod
+    def ones(shape, ctx=None, **_kw):
+        return NDArray(np.ones(shape, np.float32), ctx)
+
+    @staticmethod
+    def zeros(shape, ctx=None, **_kw):
+        return NDArray(np.zeros(shape, np.float32), ctx)
+
+
+nd = _ND()
+cpu = Context
+__version__ = "0.0-fake-async"

@@ -172,3 +172,25 @@ def test_real_mxnet_binding_smoke():
         trainer.step(3)
     finally:
         hvd.shutdown()
+
+
+def test_real_mxnet_engine_ordering():
+    """Interleaved NDArray mutations around in-place collectives must
+    serialize with the REAL async dependency engine (reference pushes
+    engine var deps, mpi_ops.cc:182-191; our bridge relies on
+    asnumpy/write sync points).  x_{k+1} = 2*x_k + 1 from 1 gives
+    2^(n+1)-1; any stale read breaks the closed form."""
+    mx = pytest.importorskip("mxnet", reason="real-mxnet lane only")
+
+    import horovod_tpu.mxnet as hvd
+
+    hvd.init()
+    try:
+        x = mx.nd.ones((4096,))
+        for _ in range(10):
+            x *= 2.0
+            hvd.allreduce_(x, name="mx.ord")
+            x += 1.0
+        assert np.allclose(x.asnumpy(), 2.0 ** 11 - 1.0), x.asnumpy()[:4]
+    finally:
+        hvd.shutdown()
