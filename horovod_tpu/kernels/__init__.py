@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for ops XLA's default lowering leaves on the table.
+
+Currently: the conv(1x1)+BatchNorm-statistics epilogue fusion
+(:mod:`.conv_bn_stats`) targeting the measured ResNet-50 bottleneck —
+BN statistics re-reading every activation from HBM (46.6% of device time,
+``docs/perf_r4.md §5``)."""
+
+from .conv_bn_stats import (  # noqa: F401
+    FusedConv1x1BN,
+    matmul_bn_stats,
+)

@@ -1,0 +1,257 @@
+"""Fused 1x1-conv + BatchNorm-statistics pallas kernel (TPU).
+
+The measured ResNet-50 plateau (``docs/perf_r4.md §5``): XLA emits the
+conv, writes the activation to HBM, then a separate reduce-fusion
+re-reads the WHOLE activation to compute BatchNorm's per-channel
+sum / sum-of-squares — ~18 GB of the step's ~38 GB HBM traffic, 46.6% of
+device time, and the one structural lever the round-4 rejection table
+left standing.  Convs are fusion roots in XLA; the compiler will not sink
+a cross-batch reduction into the conv epilogue, so this kernel does it by
+hand for the convs where that is tractable: 1x1 convolutions, which are
+plain matmuls over ``[N*H*W, Cin] @ [Cin, Cout]`` and carry roughly half
+of ResNet-50's conv count (two of three convs in every bottleneck block,
+plus every projection shortcut).
+
+Kernel shape: a blocked MXU matmul (grid ``i, j, k``; fp32 VMEM
+accumulator over the ``k`` blocks) whose epilogue — while the output tile
+is still in VMEM — reduces the tile's per-channel sum and sum-of-squares
+and writes them to per-``i`` partial rows; a tiny XLA reduction collapses
+the partials.  The activation is therefore read ZERO extra times for
+statistics (baseline: one full extra HBM read).
+
+Reference role: the fused-BN path of the reference's model zoos is cuDNN
+``conv+BN`` fusion on GPU (e.g. ``tf.keras`` ResNet under XLA:GPU/cuDNN);
+there is no reference source file to cite — the reference gets this from
+its vendor library, we get it from pallas.
+
+Numerics: accumulation and statistics in fp32 (like the shipped
+``force_float32_reductions`` BN config); output cast to the model dtype
+(bf16).  Verified against the unfused composition in interpret mode
+(``tests/test_conv_bn_kernel.py``) for values and gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DEF_BM = 256
+_DEF_BN = 256
+_DEF_BK = 256
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover — backend init failure
+        return False
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    n = x.shape[axis]
+    rem = (-n) % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)
+
+
+def _matmul_stats_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref, acc_ref):
+    """One (i, j, k) grid step: accumulate the MXU partial product; on the
+    last k block, emit the output tile and its per-channel stats partials.
+
+    Zero-padding correctness: padded M rows produce y == 0 rows which
+    contribute exactly 0 to both sum and sum-of-squares, so stats need no
+    masking; padded K columns multiply zeros into the product."""
+    import jax.experimental.pallas as pl
+
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(x_ref[:], w_ref[:],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _epilogue():
+        acc = acc_ref[:]
+        y_ref[:] = acc.astype(y_ref.dtype)
+        # Per-channel partials for THIS i block; reduced outside.
+        s1_ref[:] = jnp.sum(acc, axis=0, keepdims=True)
+        s2_ref[:] = jnp.sum(acc * acc, axis=0, keepdims=True)
+
+
+def _matmul_stats_fwd_pallas(x: jnp.ndarray, w: jnp.ndarray,
+                             bm: int, bn: int, bk: int, interpret: bool
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    xp = _pad_to(_pad_to(x, 0, bm), 1, bk)
+    wp = _pad_to(_pad_to(w, 0, bk), 1, bn)
+    mp, kp = xp.shape
+    np_ = wp.shape[1]
+    gi, gj, gk = mp // bm, np_ // bn, kp // bk
+
+    y, s1p, s2p = pl.pallas_call(
+        _matmul_stats_kernel,
+        grid=(gi, gj, gk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, np_), x.dtype),
+            jax.ShapeDtypeStruct((gi, np_), jnp.float32),
+            jax.ShapeDtypeStruct((gi, np_), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * np_ * kp,
+            bytes_accessed=(mp * kp + kp * np_) * x.dtype.itemsize
+            + mp * np_ * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(xp, wp)
+    return (y[:m, :n], jnp.sum(s1p, axis=0)[:n], jnp.sum(s2p, axis=0)[:n])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def matmul_bn_stats(x: jnp.ndarray, w: jnp.ndarray,
+                    bm: int = _DEF_BM, bn: int = _DEF_BN, bk: int = _DEF_BK,
+                    interpret: bool | None = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``y = x @ w`` plus per-channel ``(sum(y), sum(y*y))`` in one pass.
+
+    ``x``: ``[M, K]`` (model dtype, e.g. bf16), ``w``: ``[K, N]``.
+    Returns ``(y [M,N] in x.dtype, s1 [N] f32, s2 [N] f32)``.
+    ``interpret=None`` auto-selects the pallas interpreter off-TPU (CPU
+    tests / virtual meshes)."""
+    return _fwd_impl(x, w, bm, bn, bk, interpret)
+
+
+def _fwd_impl(x, w, bm, bn, bk, interpret):
+    interp = (not _on_tpu()) if interpret is None else interpret
+    return _matmul_stats_fwd_pallas(x, w, bm, bn, bk, interp)
+
+
+def _fwd_rule(x, w, bm, bn, bk, interpret):
+    y, s1, s2 = _fwd_impl(x, w, bm, bn, bk, interpret)
+    return (y, s1, s2), (x, w, y)
+
+
+def _bwd_rule(bm, bn, bk, interpret, residuals, cotangents):
+    """VJP: with ``r = dy + ds1·1ᵀ + 2·y∘ds2·1ᵀ`` (the stats cotangents
+    broadcast over rows), ``dx = r @ wᵀ`` and ``dw = xᵀ @ r`` — plain XLA
+    matmuls; the fusion win targeted the forward stats read."""
+    x, w, y = residuals
+    dy, ds1, ds2 = cotangents
+    f32 = jnp.float32
+    r = (dy.astype(f32) + ds1[None, :].astype(f32)
+         + 2.0 * y.astype(f32) * ds2[None, :].astype(f32))
+    dx = jnp.dot(r, w.astype(f32).T).astype(x.dtype)
+    dw = jnp.dot(x.astype(f32).T, r).astype(w.dtype)
+    return dx, dw
+
+
+matmul_bn_stats.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# flax module: drop-in replacement for conv(1x1, no bias) + BatchNorm
+
+import flax.linen as nn  # noqa: E402 — hard dep (resnet.py already requires it)
+
+
+class FusedConv1x1BN(nn.Module):
+    """``nn.Conv(features, (1,1), strides, use_bias=False)`` followed by
+    ``nn.BatchNorm`` with the statistics pass fused into the conv's
+    pallas epilogue (training mode).  Eval mode uses running stats and
+    a plain XLA matmul — no statistics are needed there.
+
+    Matches the model's BN config: fp32 stats, one-pass variance,
+    momentum/epsilon as given, bf16 compute.  A stride-2 1x1 conv
+    subsamples first (exact: a 1x1 kernel only reads the strided
+    positions).
+
+    **Single-device-mesh only for now**: ``pl.pallas_call`` is not
+    GSPMD-partitionable, so under a multi-device sharded jit the custom
+    call would force all-gathers of the activation (inverting the win) —
+    and the statistics would need a cross-device psum to match BN's
+    global-batch semantics.  The multi-chip integration (shard_map wrap
+    + stats psum over the data axis) is the recorded follow-up
+    (``docs/perf_r5.md``); callers gate on device count
+    (``bench.py``, ``benchmarks/resnet_levers.py``).
+    """
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    scale_init: Any = nn.initializers.ones
+    use_running_average: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (cin, self.features), jnp.float32)
+        scale = self.param("scale", self.scale_init,
+                           (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((self.features,),
+                                                  jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((self.features,),
+                                                jnp.float32))
+
+        if self.strides != (1, 1):
+            sh, sw = self.strides
+            x = x[:, ::sh, ::sw, :]
+        batch, h, w_, _ = x.shape
+        xm = x.astype(self.dtype).reshape(-1, cin)
+        count = xm.shape[0]
+
+        if self.use_running_average:
+            y = jnp.dot(xm, kernel.astype(self.dtype),
+                        preferred_element_type=jnp.float32)
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            y, s1, s2 = matmul_bn_stats(xm, kernel.astype(self.dtype))
+            y = y.astype(jnp.float32)
+            mean = s1 / count
+            # one-pass E[y^2] - E[y]^2 (the shipped fast-variance
+            # config; measured faster than two-pass, perf_r4 §5)
+            var = jnp.maximum(s2 / count - mean * mean, 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                # biased batch variance, exactly like flax BatchNorm
+                # (no Bessel correction — torch differs here)
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        inv = jax.lax.rsqrt(var + self.epsilon) * scale
+        out = (y - mean[None, :]) * inv[None, :] + bias[None, :]
+        return out.astype(self.dtype).reshape(
+            batch, h, w_, self.features)
+

@@ -26,22 +26,38 @@ class BottleneckBlock(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     strides: Tuple[int, int] = (1, 1)
+    # When set (a partial of kernels.FusedConv1x1BN), every conv(1x1)+BN
+    # pair runs the pallas fused-statistics kernel — the structural lever
+    # for the BN-stat HBM re-read (docs/perf_r4.md §5).  The 3x3 stays on
+    # XLA's conv.
+    fused_cb: ModuleDef = None
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
+        if self.fused_cb is not None:
+            y = self.fused_cb(self.filters)(x)
+        else:
+            y = self.norm()(self.conv(self.filters, (1, 1))(x))
         y = nn.relu(y)
         y = self.conv(self.filters, (3, 3), self.strides)(y)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if self.fused_cb is not None:
+            y = self.fused_cb(self.filters * 4,
+                              scale_init=nn.initializers.zeros)(y)
+        else:
+            y = self.norm(scale_init=nn.initializers.zeros)(
+                self.conv(self.filters * 4, (1, 1))(y))
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1), self.strides,
-                                 name="conv_proj")(residual)
-            residual = self.norm(name="norm_proj")(residual)
+            if self.fused_cb is not None:
+                residual = self.fused_cb(self.filters * 4,
+                                         strides=self.strides,
+                                         name="fused_proj")(residual)
+            else:
+                residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                     name="conv_proj")(residual)
+                residual = self.norm(name="norm_proj")(residual)
         return nn.relu(residual + y)
 
 
@@ -80,27 +96,50 @@ class ResNet(nn.Module):
     # E[x^2]-E[x]^2 variance.
     bn_f32_stats: bool = True
     bn_fast_variance: bool = True
+    # Fuse BN statistics into the 1x1 convs' pallas epilogue
+    # (kernels/conv_bn_stats.py) — only meaningful for BottleneckBlock.
+    fuse_conv1x1_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        bn_momentum, bn_epsilon = 0.9, 1e-5  # shared by BOTH norm paths
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
                                  param_dtype=jnp.float32)
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
-                                 momentum=0.9, epsilon=1e-5,
+                                 momentum=bn_momentum, epsilon=bn_epsilon,
                                  dtype=self.dtype, param_dtype=jnp.float32,
                                  force_float32_reductions=self.bn_f32_stats,
                                  use_fast_variance=self.bn_fast_variance)
+        fused_cb = None
+        if self.fuse_conv1x1_bn:
+            if not (self.bn_f32_stats and self.bn_fast_variance):
+                # The fused kernel is hardwired to fp32 one-pass stats;
+                # mixing it with the other BN levers would silently give
+                # the 1x1 and 3x3 norms different statistics algorithms.
+                raise ValueError(
+                    "fuse_conv1x1_bn=True requires the default BN config "
+                    "(bn_f32_stats=True, bn_fast_variance=True); the "
+                    "fused kernel computes fp32 one-pass statistics only")
+            from ..kernels import FusedConv1x1BN
+
+            fused_cb = functools.partial(
+                FusedConv1x1BN, dtype=self.dtype, momentum=bn_momentum,
+                epsilon=bn_epsilon, use_running_average=not train)
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_kwargs = {}
+        if fused_cb is not None and self.block_cls is BottleneckBlock:
+            block_kwargs["fused_cb"] = fused_cb
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = self.block_cls(self.num_filters * 2 ** i,
-                                   conv=conv, norm=norm, strides=strides)(x)
+                                   conv=conv, norm=norm, strides=strides,
+                                   **block_kwargs)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      param_dtype=jnp.float32)(x)
