@@ -131,6 +131,25 @@ def main() -> int:
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
 
+    # Bounded backend probe BEFORE this process touches jax: a wedged
+    # chip must yield a structured record, not an infinite hang (the
+    # exact defense bench.py grew after round 4 — reuse it).
+    import bench as _bench
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        probe = _bench._probe_accelerator(
+            timeout_s=float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT_S",
+                                           "120")),
+            retries=int(os.environ.get("HVD_BENCH_PROBE_RETRIES", "3")))
+        if not probe["ok"]:
+            line = json.dumps({"metric": "resnet50_bn_levers",
+                               "error": "tpu_unavailable", "probe": probe})
+            print(line)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(line + "\n")
+            return 0
+
     if args.single:
         print(json.dumps(run_config(args.single, args.iters, args.warmup,
                                     args.batch_size, True)))

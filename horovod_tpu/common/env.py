@@ -25,6 +25,10 @@ HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
 HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
 HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"  # "tcp" (our gloo-role) | "local"
+# Full-mesh TCP bring-up budget (rendezvous wait + accept + dial), secs.
+# Loaded CI hosts starting N jax runtimes concurrently need more than the
+# 60 s default; the test harness load-scales it.
+HOROVOD_MESH_STARTUP_TIMEOUT = "HOROVOD_MESH_STARTUP_TIMEOUT"
 HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"

@@ -131,8 +131,10 @@ class HorovodGlobalState:
             # (reference: workers surface through the rendezvous server and
             # horovodrun aborts if they don't within the timeout).
             store.set("worker_started", str(topo.rank), b"1")
-            self.mesh = TcpMesh(topo.rank, topo.size, store,
-                                scope=f"tcp.{epoch}")
+            self.mesh = TcpMesh(
+                topo.rank, topo.size, store, scope=f"tcp.{epoch}",
+                timeout=env_mod.get_float(
+                    env_mod.HOROVOD_MESH_STARTUP_TIMEOUT, 60.0))
         fusion = env_mod.get_int(
             env_mod.HOROVOD_FUSION_THRESHOLD, env_mod.DEFAULT_FUSION_THRESHOLD)
         stall_secs = 0 if env_mod.get_bool(env_mod.HOROVOD_STALL_CHECK_DISABLE) \

@@ -37,7 +37,6 @@ from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 _DEF_BM = 256
 _DEF_BN = 256
@@ -159,7 +158,14 @@ def _fwd_rule(x, w, bm, bn, bk, interpret):
 def _bwd_rule(bm, bn, bk, interpret, residuals, cotangents):
     """VJP: with ``r = dy + ds1·1ᵀ + 2·y∘ds2·1ᵀ`` (the stats cotangents
     broadcast over rows), ``dx = r @ wᵀ`` and ``dw = xᵀ @ r`` — plain XLA
-    matmuls; the fusion win targeted the forward stats read."""
+    matmuls; the fusion win targeted the forward stats read.
+
+    Precision note: the ``2·y∘ds2`` term uses the SAVED output ``y``
+    (model dtype, e.g. bf16) — the same rounded activation the unfused
+    baseline's backward reads from HBM for its dvar terms.  Exact in
+    f32 (``y == acc``); for bf16 the rounding matches the baseline's,
+    while the forward statistics (from the f32 accumulator) are strictly
+    more precise than the baseline's bf16-activation reductions."""
     x, w, y = residuals
     dy, ds1, ds2 = cotangents
     f32 = jnp.float32

@@ -132,7 +132,13 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         block_kwargs = {}
-        if fused_cb is not None and self.block_cls is BottleneckBlock:
+        if fused_cb is not None:
+            if self.block_cls is not BottleneckBlock:
+                # Silently building unfused would let a run labeled
+                # "fused" measure the baseline.
+                raise ValueError(
+                    "fuse_conv1x1_bn=True is only implemented for "
+                    f"BottleneckBlock (got {self.block_cls!r})")
             block_kwargs["fused_cb"] = fused_cb
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):

@@ -10,11 +10,59 @@ against the real runtime, and the test asserts on worker stdout/exit codes.
 from __future__ import annotations
 
 import os
+import socket
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# port reservation (de-flake: the bind(0)-close-reuse idiom races the OS
+# ephemeral allocator — another process can grab the port in the window
+# between close and the worker's bind).  Hand out ports from BELOW the
+# ephemeral range (Linux default 32768+), where only explicit binders
+# live, advancing a probed counter so sequential tests never reuse.
+
+_port_counter: Optional[int] = None
+
+
+def reserve_port() -> int:
+    global _port_counter
+    if _port_counter is None:
+        _port_counter = 20000 + (os.getpid() * 137) % 9000
+    for _ in range(2000):
+        _port_counter += 1
+        if _port_counter >= 32000:
+            _port_counter = 20001
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", _port_counter))
+        except OSError:
+            continue
+        finally:
+            s.close()
+        return _port_counter
+    raise RuntimeError("no free port in the reserved range")
+
+
+def scaled_mesh_startup_timeout() -> str:
+    """Load-scaled TCP-mesh bring-up budget for worker envs (the product
+    default is 60 s, core/state.py); one definition so the policy cannot
+    drift between launch helpers."""
+    return str(int(60 * _timeout_scale()))
+
+
+def _log_retry(reason: str) -> None:
+    """Record a retry-gate engagement (VERDICT r4 #4: de-flake runs must
+    prove ZERO engagements — this is the audit trail)."""
+    path = os.environ.get("HVD_TEST_RETRY_LOG")
+    if not path:
+        return
+    test = os.environ.get("PYTEST_CURRENT_TEST", "?")
+    with open(path, "a") as f:
+        f.write(f"{time.strftime('%H:%M:%S')} {test} :: {reason[:200]}\n")
 
 PREAMBLE = """
 import os, sys
@@ -132,6 +180,8 @@ def run_distributed(n: int, body: str, timeout: float = 120,
             attempt += 1
             if attempt > retries or not infra_retryable(e):
                 raise
+            _log_retry(f"run_distributed attempt {attempt}: "
+                       + str(e).splitlines()[0])
             retry_backoff(attempt)
 
 
@@ -161,6 +211,12 @@ def _run_distributed_once(n: int, body: str, timeout: float,
                 "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
                 "JAX_PLATFORMS": "cpu",
             })
+            # Mesh bring-up shares the load-scaled budget: run-1 audit of
+            # the retry log showed every engagement was a bring-up
+            # failure racing the product's fixed 60 s while neighbors'
+            # 8-proc jobs drained.
+            env.setdefault("HOROVOD_MESH_STARTUP_TIMEOUT",
+                           scaled_mesh_startup_timeout())
             env.update(extra_env or {})
             procs.append(subprocess.Popen(
                 [sys.executable, "-c", script],

@@ -35,10 +35,19 @@ def _launch_once(cmd, env, timeout):
 
 
 def _hvdrun(np_, script_args, timeout=420, extra_cli=()):
-    from .helpers import infra_retryable, retry_backoff, _timeout_scale
+    from .helpers import (
+        _log_retry,
+        _timeout_scale,
+        infra_retryable,
+        retry_backoff,
+    )
 
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                TF_CPP_MIN_LOG_LEVEL="2")
+    from .helpers import scaled_mesh_startup_timeout
+
+    env.setdefault("HOROVOD_MESH_STARTUP_TIMEOUT",
+                   scaled_mesh_startup_timeout())
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
            "-np", str(np_), *extra_cli, sys.executable, *script_args]
     # Same load-scaled-timeout + infra-retry intent as
@@ -56,6 +65,7 @@ def _hvdrun(np_, script_args, timeout=420, extra_cli=()):
             and "AssertionError" not in blob
         if attempt == 2 or not retryable:
             break
+        _log_retry(f"_hvdrun attempt {attempt + 1}: timed_out={timed_out}")
         retry_backoff(attempt + 1)
     assert code == 0, (
         f"timed_out={timed_out} (budget {timeout * _timeout_scale():.0f}s)",

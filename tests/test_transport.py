@@ -16,7 +16,14 @@ pytestmark = pytest.mark.smoke
 
 
 def run_ranks(size, fn, timeout=30):
-    """Run fn(rank) on `size` threads; re-raise the first failure."""
+    """Run fn(rank) on `size` threads; re-raise the first failure.
+
+    The join budget is load-scaled like every other suite timeout: mesh
+    bring-up with 5 s-per-socket accept/dial steps legitimately exceeds a
+    fixed 30 s when the box is saturated (the "rank thread hung" flake,
+    run-2 audit)."""
+    from .helpers import _timeout_scale
+
     errs = []
     results = [None] * size
 
@@ -30,8 +37,9 @@ def run_ranks(size, fn, timeout=30):
                for r in range(size)]
     for t in threads:
         t.start()
+    budget = timeout * _timeout_scale()
     for t in threads:
-        t.join(timeout)
+        t.join(budget)
         assert not t.is_alive(), "rank thread hung"
     if errs:
         raise errs[0][1]

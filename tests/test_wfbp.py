@@ -8,7 +8,6 @@ single-process training on the concatenated batch (sync-DP equivalence),
 (c) misuse raises.
 """
 
-import socket
 
 import numpy as np
 import pytest
@@ -17,10 +16,9 @@ from .helpers import run_distributed
 
 
 def _xla_env() -> dict:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    from .helpers import reserve_port
+
+    port = reserve_port()
     return {
         "HOROVOD_DATA_PLANE": "xla",
         "HOROVOD_JAX_COORDINATOR": f"127.0.0.1:{port}",

@@ -11,7 +11,6 @@ Reference analog: ``test/parallel/test_tensorflow.py`` GPU collective
 sections (:336-455) executed under a real multi-process launcher.
 """
 
-import socket
 
 import numpy as np
 import pytest
@@ -22,11 +21,9 @@ jax = pytest.importorskip("jax")
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from .helpers import reserve_port
+
+    return reserve_port()
 
 
 def _xla_env() -> dict:
