@@ -163,14 +163,15 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(data=-1))
     # Opt-in pallas conv1x1+BN-stat fusion (kernels/conv_bn_stats.py);
     # flip the default only on a measured win (benchmarks/resnet_levers.py
-    # "fused_conv1x1_bn" lever).  TPU-only (CPU would interpret the
-    # kernel) and single-device-only (pallas_call is not
-    # GSPMD-partitionable; a sharded jit would all-gather activations).
-    fused_bn = on_tpu and n_dev == 1 \
-        and os.environ.get("HVD_BENCH_FUSED_BN") == "1"
+    # "fused_conv1x1_bn" lever).  TPU-only: CPU would interpret the
+    # kernel.  Multi-device runs go through the shard_map flavor
+    # (psum'd statistics) via fused_bn_mesh.
+    fused_bn = on_tpu and os.environ.get("HVD_BENCH_FUSED_BN") == "1"
     model = ResNet50(num_classes=1000,
                      dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-                     fuse_conv1x1_bn=fused_bn)
+                     fuse_conv1x1_bn=fused_bn,
+                     fused_bn_mesh=mesh if fused_bn and n_dev > 1
+                     else None)
     tx = optax.sgd(0.01, momentum=0.9)
 
     rng = np.random.RandomState(0)

@@ -71,6 +71,10 @@ def run_config(name: str, iters: int, warmup: int, batch_size: int,
         "fused_conv1x1_bn": {"fuse_conv1x1_bn": True},
     }[name]  # unknown names must raise, not silently measure baseline
 
+    mesh = build_mesh(MeshSpec(data=-1))
+    n_dev = len(jax.devices())
+    if overrides.get("fuse_conv1x1_bn") and n_dev > 1:
+        overrides["fused_bn_mesh"] = mesh  # shard_map flavor
     model = ResNet50(num_classes=1000,
                      dtype=jnp.bfloat16 if on_tpu else jnp.float32,
                      **overrides)
@@ -79,7 +83,6 @@ def run_config(name: str, iters: int, warmup: int, batch_size: int,
     x = jnp.asarray(rng.rand(bs, img, img, 3), jnp.float32)
     y = jnp.asarray(rng.randint(0, 1000, size=(bs,)), jnp.int32)
 
-    mesh = build_mesh(MeshSpec(data=-1))
     state = create_train_state(model, jax.random.PRNGKey(0), x, tx,
                                mesh=mesh, init_kwargs={"train": True})
     step = make_sharded_train_step(model, tx, mesh, has_batch_stats=True,
@@ -134,6 +137,8 @@ def main() -> int:
     # Bounded backend probe BEFORE this process touches jax: a wedged
     # chip must yield a structured record, not an infinite hang (the
     # exact defense bench.py grew after round 4 — reuse it).
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
     import bench as _bench
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
@@ -160,16 +165,14 @@ def main() -> int:
     on_tpu = jax.devices()[0].platform == "tpu"
     results = {}
     configs = ["baseline", "bf16_stats", "two_pass_var"]
-    if on_tpu and len(jax.devices()) == 1:
-        # fused lever: TPU-only (interpret mode on CPU would run dozens
-        # of interpreted pallas grids per grad step) and single-device
-        # (pallas_call is not GSPMD-partitionable yet — see
-        # kernels/conv_bn_stats.py docstring).
+    if on_tpu:
+        # fused lever: TPU-only — interpret mode on CPU would run dozens
+        # of interpreted pallas grids per grad step.  Multi-device runs
+        # use the shard_map flavor (psum'd statistics).
         configs.append("fused_conv1x1_bn")
     else:
         results["fused_conv1x1_bn"] = {
-            "skipped": "needs a single-device TPU mesh (pallas kernel; "
-                       "no GSPMD partitioning, no CPU interpret timing)"}
+            "skipped": "TPU-only (pallas kernel; no CPU interpret timing)"}
     for name in configs:
         results[name] = run_config(name, args.iters, args.warmup,
                                    args.batch_size, True)

@@ -8,4 +8,5 @@ BN statistics re-reading every activation from HBM (46.6% of device time,
 from .conv_bn_stats import (  # noqa: F401
     FusedConv1x1BN,
     matmul_bn_stats,
+    sharded_matmul_bn_stats,
 )

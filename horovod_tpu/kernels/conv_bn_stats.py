@@ -179,6 +179,30 @@ def _bwd_rule(bm, bn, bk, interpret, residuals, cotangents):
 matmul_bn_stats.defvjp(_fwd_rule, _bwd_rule)
 
 
+def sharded_matmul_bn_stats(x: jnp.ndarray, w: jnp.ndarray, mesh,
+                            data_axis: str = "data"):
+    """Multi-device flavor: the kernel runs per-shard under ``shard_map``
+    (rows sharded on ``data_axis``, weights replicated) and the statistics
+    partials are ``psum``-reduced across the axis — matching BatchNorm's
+    global-batch semantics under the GSPMD train step.  This is the
+    multi-chip integration the plain ``pl.pallas_call`` cannot get from
+    GSPMD (it is not partitionable; unwrapped it would all-gather the
+    activation)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import shard_map_fn
+
+    def local_fn(xs, ws):
+        y, s1, s2 = matmul_bn_stats(xs, ws)
+        return (y, jax.lax.psum(s1, data_axis),
+                jax.lax.psum(s2, data_axis))
+
+    return shard_map_fn(
+        local_fn, mesh,
+        in_specs=(P(data_axis, None), P(None, None)),
+        out_specs=(P(data_axis, None), P(None), P(None)))(x, w)
+
+
 # ---------------------------------------------------------------------------
 # flax module: drop-in replacement for conv(1x1, no bias) + BatchNorm
 
@@ -196,14 +220,13 @@ class FusedConv1x1BN(nn.Module):
     subsamples first (exact: a 1x1 kernel only reads the strided
     positions).
 
-    **Single-device-mesh only for now**: ``pl.pallas_call`` is not
-    GSPMD-partitionable, so under a multi-device sharded jit the custom
-    call would force all-gathers of the activation (inverting the win) —
-    and the statistics would need a cross-device psum to match BN's
-    global-batch semantics.  The multi-chip integration (shard_map wrap
-    + stats psum over the data axis) is the recorded follow-up
-    (``docs/perf_r5.md``); callers gate on device count
-    (``bench.py``, ``benchmarks/resnet_levers.py``).
+    Multi-device: pass ``mesh`` (and ``data_axis``) — the kernel then
+    runs per-shard under ``shard_map`` with ``psum``-reduced statistics
+    (:func:`sharded_matmul_bn_stats`), preserving BN's global-batch
+    semantics.  This wrap is required because ``pl.pallas_call`` is not
+    GSPMD-partitionable: unwrapped under a sharded jit it would force
+    all-gathers of the activation.  Without ``mesh`` the plain
+    single-device kernel runs.
     """
 
     features: int
@@ -213,6 +236,11 @@ class FusedConv1x1BN(nn.Module):
     epsilon: float = 1e-5
     scale_init: Any = nn.initializers.ones
     use_running_average: bool = False
+    # Multi-device: when a Mesh with >1 device on `data_axis` is given,
+    # the kernel runs under shard_map with psum'd statistics (see
+    # sharded_matmul_bn_stats); otherwise the plain single-device call.
+    mesh: Any = None
+    data_axis: str = "data"
 
     @nn.compact
     def __call__(self, x):
@@ -244,7 +272,13 @@ class FusedConv1x1BN(nn.Module):
                         preferred_element_type=jnp.float32)
             mean, var = ra_mean.value, ra_var.value
         else:
-            y, s1, s2 = matmul_bn_stats(xm, kernel.astype(self.dtype))
+            wk = kernel.astype(self.dtype)
+            if self.mesh is not None and \
+                    dict(self.mesh.shape).get(self.data_axis, 1) > 1:
+                y, s1, s2 = sharded_matmul_bn_stats(
+                    xm, wk, self.mesh, self.data_axis)
+            else:
+                y, s1, s2 = matmul_bn_stats(xm, wk)
             y = y.astype(jnp.float32)
             mean = s1 / count
             # one-pass E[y^2] - E[y]^2 (the shipped fast-variance

@@ -99,6 +99,10 @@ class ResNet(nn.Module):
     # Fuse BN statistics into the 1x1 convs' pallas epilogue
     # (kernels/conv_bn_stats.py) — only meaningful for BottleneckBlock.
     fuse_conv1x1_bn: bool = False
+    # For multi-device training: the Mesh whose "data" axis shards the
+    # batch (the fused kernel runs under shard_map with psum'd stats).
+    # None = single-device kernel.
+    fused_bn_mesh: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -124,7 +128,8 @@ class ResNet(nn.Module):
 
             fused_cb = functools.partial(
                 FusedConv1x1BN, dtype=self.dtype, momentum=bn_momentum,
-                epsilon=bn_epsilon, use_running_average=not train)
+                epsilon=bn_epsilon, use_running_average=not train,
+                mesh=self.fused_bn_mesh)
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="conv_init")(x)

@@ -43,6 +43,9 @@ def pytest_configure(config):
         os.path.join(tempfile.gettempdir(),
                      f"hvd_retries_{_time.strftime('%Y%m%d_%H%M%S')}"
                      f"_{os.getpid()}.log"))
+    # "engagements this run" must mean THIS run even when the operator
+    # pins the log path across runs: start from an empty file.
+    open(os.environ["HVD_TEST_RETRY_LOG"], "w").close()
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
