@@ -37,6 +37,10 @@ def main() -> None:
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--out", default=None,
                         help="also write the JSON result to this path")
+    parser.add_argument("--profile", action="store_true", default=False,
+                        help="include the per-phase dispatch-chain "
+                             "breakdown (negotiate/fuse/collective/unfuse/"
+                             "wait) for the eager timed region")
     args = parser.parse_args()
 
     import jax
@@ -115,14 +119,18 @@ def main() -> None:
         params = apply_updates(params, updates)
         return loss
 
+    from horovod_tpu.core.timeline import phase_stats
+
     for _ in range(warmup):
         loss = eager_step()
     float(loss)
+    phase_stats.reset()  # profile the steady-state timed region only
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = eager_step()
     final_loss = float(loss)
     eager_dt = (time.perf_counter() - t0) / iters
+    phase_breakdown = phase_stats.snapshot()
     assert np.isfinite(final_loss)
 
     # ---- wfbp flavor: forward+backward+allreduce+update, ONE program --
@@ -171,6 +179,10 @@ def main() -> None:
         "wfbp_gap_pct": round((wfbp_dt - jit_dt) / jit_dt * 100, 2),
         "xla_dispatch_stats": dict(xla_backend.stats),
     }
+    if args.profile:
+        # Where the eager step's overhead budget goes, per phase, over the
+        # timed region (totals across all iters; mean per occurrence).
+        result["phase_breakdown_ms"] = phase_breakdown
     hvd.shutdown()
     line = json.dumps(result)
     print(line)

@@ -37,10 +37,13 @@ import json
 import time
 
 
-def _bench(fn, warmup: int, iters: int) -> float:
-    """Mean seconds per call."""
+def _bench(fn, warmup: int, iters: int, after_warmup=None) -> float:
+    """Mean seconds per call; ``after_warmup`` runs between the warmup and
+    the timed region (e.g. resetting profile accumulators)."""
     for _ in range(warmup):
         fn()
+    if after_warmup is not None:
+        after_warmup()
     t0 = time.perf_counter()
     for _ in range(iters):
         fn()
@@ -55,6 +58,9 @@ def main() -> None:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--iters", type=int, default=20)
     parser.add_argument("--out", default=None)
+    parser.add_argument("--profile", action="store_true", default=False,
+                        help="include the per-phase dispatch-chain "
+                             "breakdown and controller fast-path counters")
     args = parser.parse_args()
 
     import os
@@ -126,8 +132,14 @@ def main() -> None:
         return run
 
     # -- eager: negotiate+fuse+collective every step --------------------
+    from horovod_tpu.core.timeline import phase_stats
+
+    # phase_stats resets after warmup so the breakdown covers the
+    # steady-state (cache-warm) timed region only.
     eager_dt = _bench(eager_flavor(DistributedOptimizer(tx)),
-                      args.warmup, args.iters)
+                      args.warmup, args.iters,
+                      after_warmup=phase_stats.reset)
+    phase_breakdown = phase_stats.snapshot()
 
     # -- eager overlap: WFBP microbatch pipeline (2 backwards/step) ------
     # n_calls=2 → one full accumulation window per run; per-backward time
@@ -166,6 +178,9 @@ def main() -> None:
                                     args.iters) * 1e3, 3)
 
     from horovod_tpu.backend import xla as xla_backend
+    from horovod_tpu.core.state import global_state
+
+    ctrl = global_state().controller
     result = {
         "metric": "eager_np_dispatch_chain",
         "world_size": size,
@@ -182,7 +197,15 @@ def main() -> None:
         "dispatch_probe_ms": probe,
         "per_dispatch_overhead_ms": probe[256],
         "xla_dispatch_stats": dict(xla_backend.stats),
+        # Steady-state fast-path engagement over the whole run: cycles
+        # negotiated with mask frames only (zero Request/Response
+        # payloads) vs Requests ever serialized by this rank.
+        "fast_cycles": ctrl.fast_cycle_count if ctrl else 0,
+        "requests_serialized": ctrl.serialized_request_count if ctrl else 0,
+        "cache_hits": ctrl.cache_hit_count if ctrl else 0,
     }
+    if args.profile:
+        result["phase_breakdown_ms"] = phase_breakdown
     hvd.shutdown()
     if rank == 0:
         line = json.dumps(result)

@@ -123,7 +123,7 @@ class XlaContext:
                 self.mesh = Mesh(np.array([self.device]), ("proc",))
                 self.ready = True
                 return
-            if not jax.distributed.is_initialized():
+            if not jax_distributed_initialized():
                 _fail("jax.distributed is not initialized")
                 return
             if jax.process_count() != topo.size or \
@@ -350,8 +350,9 @@ class XlaContext:
         log2(P) ppermute rounds with per-entry dot/norm combines."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import shard_map_fn
 
         key = ("adasum", shapes, bucket, str(np_dtype), prescale, postscale)
 
@@ -416,8 +417,8 @@ class XlaContext:
             # same value, but the tracer cannot prove ppermute outputs
             # replicated.
             return jax.jit(
-                shard_map(f, mesh=self.mesh, in_specs=P("proc"),
-                          out_specs=P(), check_vma=False),
+                shard_map_fn(f, self.mesh, in_specs=P("proc"),
+                             out_specs=P(), check_vma=False),
                 in_shardings=(in_sh,), out_shardings=rep)
 
         return self._get(key, build)
@@ -511,6 +512,24 @@ def is_jax_array(t: Any) -> bool:
         return False
 
 
+def jax_distributed_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` across jax versions: the
+    public predicate only exists in newer jax; older releases (e.g.
+    0.4.37) expose the same fact as the distributed global state's live
+    client.  Without this shim the whole np>1 XLA data plane is
+    unavailable on those versions (the AttributeError aborts init)."""
+    import jax
+
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # noqa: BLE001 — unknown layout: assume not up
+        return False
+
+
 def data_plane_requested() -> str:
     """'xla' | 'auto' | 'cpu' from HOROVOD_DATA_PLANE.
 
@@ -558,24 +577,37 @@ class XlaAllreduce(XlaOp):
 
     def execute(self, response: Response,
                 entries: List[TensorTableEntry]) -> Status:
+        import time
+
+        from ..core.timeline import phase_stats
+
         ctx = self.ctx
         np_dtype = response.tensor_type.to_numpy()
         if self.topo.size == 1:
+            t0 = time.monotonic()
             outs = ctx.local_allreduce(entries, np_dtype,
                                        response.prescale_factor,
                                        response.postscale_factor)
+            phase_stats.add("collective", time.monotonic() - t0)
         else:
             total = sum(int(np.prod(e.tensor.shape)) if e.tensor.shape else 1
                         for e in entries)
             bucket = bucket_elems(total)
             shapes = tuple(tuple(e.tensor.shape) for e in entries)
+            t0 = time.monotonic()
             fused = ctx.fuse(entries, bucket, np_dtype)
+            gin = ctx.global_input(fused)
+            t1 = time.monotonic()
+            phase_stats.add("fuse", t1 - t0)
             fn = ctx.allreduce_unfuse_fn(shapes, bucket, np_dtype,
                                          response.prescale_factor,
                                          response.postscale_factor)
-            outs = fn(ctx.global_input(fused))
+            outs = fn(gin)
+            phase_stats.add("collective", time.monotonic() - t1)
+        t2 = time.monotonic()
         for e, o in zip(entries, outs):
             e.output = _localize(o)
+        phase_stats.add("unfuse", time.monotonic() - t2)
         _count("allreduce")
         return Status.dispatched()
 
@@ -821,7 +853,7 @@ class XlaAlltoall(XlaOp):
         key = ("a2a.ragged", tuple(matrix), inner, str(np_dtype))
 
         def build():
-            from jax import shard_map
+            from ..parallel.sharding import _shard_map as shard_map
 
             elems = m * inner_n
             in_offs = np.zeros((size, size), np.int32)

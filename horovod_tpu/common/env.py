@@ -41,6 +41,10 @@ HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+# Double-buffer the background loop: cycle i+1's negotiation overlaps cycle
+# i's device-collective dispatch on a dedicated thread (size > 1 only;
+# host-TCP responses still execute inline behind a drain barrier).
+HOROVOD_PIPELINE_DISPATCH = "HOROVOD_PIPELINE_DISPATCH"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"

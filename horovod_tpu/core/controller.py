@@ -41,12 +41,14 @@ from ..common.topology import ProcessTopology
 from ..transport.tcp import TcpMesh
 from .messages import (
     DataType,
+    MaskFrame,
     Request,
     RequestList,
     RequestType,
     Response,
     ResponseList,
     ResponseType,
+    is_mask_frame,
 )
 
 log = get_logger("horovod_tpu.controller")
@@ -139,6 +141,16 @@ class Controller:
         self._cycle_evictions: List[int] = []
         self.cache_hit_count = 0
         self.cache_miss_count = 0
+        # Fast-path accounting (tests + benchmarks assert against these):
+        # fast_cycle_count counts mask-only cycles that COMPLETED at least
+        # one tensor (idle polling cycles also ride the compact frames but
+        # would swamp the metric, so they count separately), and
+        # serialized_request_count is the number of Requests this rank
+        # ever put on / took off the wire.
+        self.fast_cycle_count = 0
+        self.idle_fast_cycle_count = 0
+        self.mask_only_sent_count = 0
+        self.serialized_request_count = 0
         # Mask fast path (coordinator): per-rank pending cache-bit masks,
         # aggregated with big-int AND/OR — O(ranks) C-speed work per cycle
         # instead of O(ranks × tensors) Python (reference bitvector
@@ -185,8 +197,10 @@ class Controller:
 
     def _worker_payload(self, requests: List[Request],
                         should_shutdown: bool) -> bytes:
-        """This rank's RequestList for the cycle (cache-mirror hits become
-        mask bits)."""
+        """This rank's cycle contribution: a compact MaskFrame when every
+        pending tensor hit the cache mirror (the steady-state case —
+        including idle cycles, whose mask is empty), a full RequestList
+        otherwise."""
         hits: List[int] = []
         if self._mirror is not None:
             misses = []
@@ -202,10 +216,14 @@ class Controller:
         mask = 0
         for bit in hits:
             mask |= 1 << bit
-        return RequestList(
-            requests=requests, shutdown=should_shutdown,
-            cache_mask=mask.to_bytes((mask.bit_length() + 7) // 8,
-                                     "little")).to_bytes()
+        mask_bytes = mask.to_bytes((mask.bit_length() + 7) // 8, "little")
+        if self._mirror is not None and not requests:
+            self.mask_only_sent_count += 1
+            return MaskFrame(mask=mask_bytes,
+                             shutdown=should_shutdown).to_bytes()
+        self.serialized_request_count += len(requests)
+        return RequestList(requests=requests, shutdown=should_shutdown,
+                           cache_mask=mask_bytes).to_bytes()
 
     def _apply_response_list(self, rlist: ResponseList) -> ResponseList:
         if self._mirror is not None:
@@ -214,21 +232,35 @@ class Controller:
             self.fusion_threshold = rlist.tuned_params[0]
         return rlist
 
+    def _apply_reply(self, payload: bytes) -> ResponseList:
+        """Decode the coordinator's verdict: a MaskFrame reply means every
+        rank's cycle was fully cached — reconstruct the Responses locally
+        from the mirrored templates (zero Response payloads shipped)."""
+        if is_mask_frame(payload):
+            frame = MaskFrame.from_bytes(payload)
+            if frame.mask_int:
+                self.fast_cycle_count += 1
+            else:
+                self.idle_fast_cycle_count += 1
+            return self._responses_from_agreed_mask(frame.mask_int,
+                                                    frame.shutdown)
+        return self._apply_response_list(ResponseList.from_bytes(payload))
+
     def _worker_round(self, requests: List[Request],
                       should_shutdown: bool) -> ResponseList:
         payload = self._worker_payload(requests, should_shutdown)
         if self.fanout_topology == "tree":
             return self._worker_round_tree(payload)
         self.mesh.send(0, payload)
-        rlist = ResponseList.from_bytes(self.mesh.recv(0))
-        return self._apply_response_list(rlist)
+        return self._apply_reply(self.mesh.recv(0))
 
     def _worker_round_tree(self, payload: bytes) -> ResponseList:
         """Binomial-tree flavor: relay the subtree's gather bundles up to
         the parent, then relay the response broadcast down to the
         children.  Depth is O(log P) versus the star's O(P) serial
         coordinator loop; interior ranks do O(subtree) byte copies but
-        those run in parallel across the tree."""
+        those run in parallel across the tree.  Payloads (and the reply)
+        are opaque bytes to the relays, so mask frames ride unchanged."""
         rank, size = self.topo.rank, self.topo.size
         entries = [(rank, payload)]
         for child in tree_children(rank, size):
@@ -237,23 +269,35 @@ class Controller:
         resp_payload = self.mesh.recv(tree_parent(rank))
         for child in tree_children(rank, size):
             self.mesh.send(child, resp_payload)
-        return self._apply_response_list(
-            ResponseList.from_bytes(resp_payload))
+        return self._apply_reply(resp_payload)
+
+    def _decode_worker_payload(self, payload: bytes):
+        """(RequestList, was_mask_frame) from either wire flavor."""
+        if is_mask_frame(payload):
+            frame = MaskFrame.from_bytes(payload)
+            return RequestList(shutdown=frame.shutdown,
+                               cache_mask=frame.mask), True
+        rl = RequestList.from_bytes(payload)
+        self.serialized_request_count += len(rl.requests)
+        return rl, False
 
     def _gather_request_lists(self):
-        """Yield every other rank's (rank, RequestList) for this cycle, in
-        deterministic rank order for the tree (the star's serial loop is
-        ordered by construction)."""
+        """Yield every other rank's (rank, RequestList, was_mask) for this
+        cycle, in deterministic rank order for the tree (the star's serial
+        loop is ordered by construction)."""
         if self.fanout_topology == "tree":
             entries: List[tuple] = []
             for child in tree_children(0, self.topo.size):
                 entries.extend(_decode_bundle(self.mesh.recv(child)))
             entries.sort()
             for rank, payload in entries:
-                yield rank, RequestList.from_bytes(payload)
+                rl, was_mask = self._decode_worker_payload(payload)
+                yield rank, rl, was_mask
         else:
             for worker in range(1, self.topo.size):
-                yield worker, RequestList.from_bytes(self.mesh.recv(worker))
+                rl, was_mask = self._decode_worker_payload(
+                    self.mesh.recv(worker))
+                yield worker, rl, was_mask
 
     def _broadcast_response_payload(self, payload: bytes) -> None:
         if self.fanout_topology == "tree":
@@ -272,6 +316,7 @@ class Controller:
         ready: List[str] = list(self._stall_completed)
         self._stall_completed.clear()
         pending = self._pending_masks
+        own_all_cached = True
         for req in own_requests:
             bit = self._cache.lookup(cache_key(req)) \
                 if self._cache is not None \
@@ -279,9 +324,13 @@ class Controller:
             if bit is not None:
                 pending[0] = pending.get(0, 0) | (1 << bit)
                 self.cache_hit_count += 1
-            elif self._increment(req):
-                ready.append(req.tensor_name)
-        for worker, rl in self._gather_request_lists():
+            else:
+                own_all_cached = False
+                if self._increment(req):
+                    ready.append(req.tensor_name)
+        all_mask_frames = True
+        for worker, rl, was_mask in self._gather_request_lists():
+            all_mask_frames = all_mask_frames and was_mask
             should_shutdown = should_shutdown or rl.shutdown
             if rl.cache_mask:
                 pending[worker] = pending.get(worker, 0) | int.from_bytes(
@@ -307,12 +356,36 @@ class Controller:
 
         responses = [self._construct_response(name) for name in ready]
         responses = [r for r in responses if r is not None]
-        responses.extend(self._mask_round(pending))
+        mask_responses, ready_mask, mask_pure = self._mask_round(pending)
+        responses.extend(mask_responses)
         tuned = self._autotune(responses)
         responses = self._fuse_responses(responses)
         self._check_stalls()
         if self._cache is not None:
             self._cache.tick()
+
+        # Zero-payload fast path: every rank's cycle was pure cache bits
+        # (or idle) and the verdict is pure templates — broadcast only the
+        # agreed bitvector; every rank (this one included, above)
+        # reconstructs the identical fused ResponseList locally.  Any
+        # cache-maintenance, tally, join, stall, or autotune traffic this
+        # cycle forces the full ResponseList so that state ships.
+        fast = (self.cache_enabled and own_all_cached and all_mask_frames
+                and mask_pure and not ready and not self._joined_ranks
+                and tuned is None and not self._cycle_assignments
+                and not self._cycle_evictions and not self._stall_completed)
+        if fast:
+            if ready_mask:
+                self.fast_cycle_count += 1
+            else:
+                self.idle_fast_cycle_count += 1
+            mask_bytes = ready_mask.to_bytes(
+                (ready_mask.bit_length() + 7) // 8, "little")
+            self._broadcast_response_payload(
+                MaskFrame(mask=mask_bytes,
+                          shutdown=should_shutdown).to_bytes())
+            return ResponseList(responses=responses,
+                                shutdown=should_shutdown)
 
         rlist = ResponseList(responses=responses, shutdown=should_shutdown,
                              cache_assignments=self._cycle_assignments,
@@ -322,7 +395,43 @@ class Controller:
         self._broadcast_response_payload(payload)
         return rlist
 
-    def _mask_round(self, pending: Dict[int, int]) -> List[Response]:
+    def _bit_template(self, bit: int) -> Optional[Request]:
+        """Cached request template for a bit, from whichever side's cache
+        this rank holds."""
+        if self._cache is not None:
+            return self._cache.rehydrate(bit, 0)
+        if self._mirror is not None:
+            return self._mirror.template(bit)
+        return None
+
+    def _responses_from_agreed_mask(self, mask: int,
+                                    shutdown: bool) -> ResponseList:
+        """Reconstruct the cycle's ResponseList from an agreed bitvector —
+        the worker half of the zero-payload fast path.  Must mirror the
+        coordinator's construction exactly: templates in ascending bit
+        order, then the deterministic fusion scan under the (synchronized)
+        threshold."""
+        from ..common.exceptions import HorovodInternalError
+
+        responses: List[Response] = []
+        rm = mask
+        while rm:
+            low = rm & -rm
+            bit = low.bit_length() - 1
+            rm ^= low
+            tpl = self._bit_template(bit)
+            if tpl is None:
+                # Protocol invariant: an agreed bit was announced by every
+                # rank, so every rank holds its template.  Losing it means
+                # divergent cache state — fail loudly, don't desync.
+                raise HorovodInternalError(
+                    f"fast-path agreed cache bit {bit} has no local "
+                    "template (cache mirror diverged from coordinator)")
+            responses.append(self._response_from_template(tpl))
+        return ResponseList(responses=self._fuse_responses(responses),
+                            shutdown=shutdown)
+
+    def _mask_round(self, pending: Dict[int, int]):
         """Resolve the cache-bit masks: a bit set in EVERY active rank's
         pending mask is globally ready and its Response comes straight from
         the cached template (no per-rank tallying or re-validation — a hit
@@ -331,9 +440,17 @@ class Controller:
         Also merges the transition case where some ranks sent a bit while
         others sent a full Request for the same tensor (e.g. around an
         eviction): those bits convert into table tallies so neither side
-        strands."""
+        strands.
+
+        Returns ``(responses, ready_mask, pure)``; ``pure`` is True iff
+        every response came straight from a live template in ready-bit
+        order — the precondition for answering the cycle with the agreed
+        bitvector alone (the coordinator half of the fast path).  Any
+        eviction recovery, table merge, dropped bit, or error response
+        clears it."""
         if not pending:
-            return []
+            return [], 0, True
+        pure = True
         responses: List[Response] = []
         if self._cycle_evictions:
             # A bit evicted this cycle may still be pending on some ranks
@@ -346,6 +463,7 @@ class Controller:
                 low = 1 << bit
                 if not any(m & low for m in pending.values()):
                     continue
+                pure = False
                 tpl = self._cache.rehydrate(bit, 0) if self._cache else None
                 completed = False
                 for r, m in list(pending.items()):
@@ -364,7 +482,7 @@ class Controller:
         for m in pending.values():
             union |= m
         if union == 0:
-            return responses
+            return responses, 0, pure
 
         ready_mask = None
         for r in range(self.topo.size):
@@ -392,9 +510,11 @@ class Controller:
             tpl = self._cache.rehydrate(bit, 0) if self._cache else None
             if tpl is None:
                 log.error("ready unknown cache bit %d; dropping", bit)
+                pure = False
                 continue
             if tpl.request_type == RequestType.BROADCAST and \
                     self._joined_ranks:
+                pure = False
                 responses.append(Response(
                     response_type=ResponseType.ERROR,
                     tensor_names=[tpl.tensor_name],
@@ -422,8 +542,10 @@ class Controller:
                 if tpl is None:
                     log.error("pending unknown cache bit %d; dropping", bit)
                     self._clear_bit(bit)
+                    pure = False
                     continue
                 if tpl.tensor_name in self._message_table:
+                    pure = False
                     completed = False
                     for r, m in list(pending.items()):
                         if m & low:
@@ -435,7 +557,7 @@ class Controller:
                         resp = self._construct_response(tpl.tensor_name)
                         if resp is not None:
                             responses.append(resp)
-        return responses
+        return responses, ready_mask, pure
 
     def _clear_bit(self, bit: int) -> None:
         low = 1 << bit

@@ -65,3 +65,49 @@ class HandleManager:
         if not status.ok:
             raise HorovodInternalError(status.error_message)
         return result
+
+    def wait_many(self, handles, timeout: Optional[float] = None) -> list:
+        """Wait for a batch of handles; returns their results in order.
+
+        One pass, one lock round per batch for the collection step — the
+        per-fused-bucket wait the framework wrappers use instead of a
+        per-tensor ``wait`` loop.  ``timeout`` bounds the WHOLE batch (one
+        deadline, not per handle).  On any failure — error status or
+        timeout — every handle in the batch is released before raising,
+        so a partially-failed step cannot leak events."""
+        import time
+
+        events = []
+        with self._lock:
+            for h in handles:
+                event = self._events.get(h)
+                if event is None:
+                    raise ValueError(f"unknown handle {h}")
+                events.append(event)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        timed_out = None
+        for h, event in zip(handles, events):
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            if not event.wait(left):
+                timed_out = h
+                break
+        results, first_error = [], None
+        with self._lock:
+            for h in handles:
+                done = self._done.pop(h, None)
+                self._events.pop(h, None)
+                if done is None:        # timed out before completion
+                    results.append(None)
+                    continue
+                status, result = done
+                if not status.ok and first_error is None:
+                    first_error = status.error_message
+                results.append(result)
+        if timed_out is not None:
+            raise TimeoutError(
+                f"collective batch timed out after {timeout}s waiting on "
+                f"handle {timed_out}")
+        if first_error is not None:
+            raise HorovodInternalError(first_error)
+        return results

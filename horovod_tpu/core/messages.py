@@ -23,6 +23,7 @@ from typing import List, Sequence
 import numpy as np
 
 WIRE_MAGIC = 0x48564454  # "HVDT"
+MASK_MAGIC = 0x4B53414D  # "MASK" — steady-state fast-path frame
 
 
 class DataType(enum.IntEnum):
@@ -293,6 +294,56 @@ class RequestList:
         reqs = [Request.deserialize(r) for _ in range(r.u32())]
         return RequestList(requests=reqs, shutdown=shutdown,
                            cache_hits=cache_hits, cache_mask=mask)
+
+
+@dataclass
+class MaskFrame:
+    """Compact steady-state negotiation frame — the zero-round-trip-payload
+    cache fast path.
+
+    When every pending tensor on a rank hits its cache mirror, the rank's
+    whole cycle contribution is a bitvector; and when that holds on EVERY
+    rank, the coordinator's whole verdict is the AND of those bitvectors.
+    This frame carries exactly that (plus the shutdown flag) in both
+    directions, replacing full ``RequestList``/``ResponseList`` payloads:
+    each rank reconstructs the agreed Responses locally from its cached
+    request templates (``controller._responses_from_agreed_mask``).  The
+    reference's bitvector-allreduce cache sync (``controller.cc:826-851``)
+    achieves the same wire shape inside MPI; ours is explicit because the
+    frame must be self-describing next to the full-payload flavor (the
+    leading magic distinguishes them).
+    """
+
+    mask: bytes = b""        # little-endian big-int bitvector
+    shutdown: bool = False
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        w.u32(MASK_MAGIC)
+        w.u8(1 if self.shutdown else 0)
+        w.u32(len(self.mask))
+        w.buf += self.mask
+        return w.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "MaskFrame":
+        r = Reader(data)
+        if r.u32() != MASK_MAGIC:
+            raise ValueError("bad mask-frame magic")
+        shutdown = bool(r.u8())
+        n = r.u32()
+        return MaskFrame(mask=bytes(r.buf[r.pos:r.pos + n]),
+                         shutdown=shutdown)
+
+    @property
+    def mask_int(self) -> int:
+        return int.from_bytes(self.mask, "little")
+
+
+def is_mask_frame(data: bytes) -> bool:
+    """True when ``data`` is a MaskFrame (vs RequestList/ResponseList)."""
+    return len(data) >= 4 and \
+        struct.unpack_from("<I", data)[0] == MASK_MAGIC
 
 
 @dataclass

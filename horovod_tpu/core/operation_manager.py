@@ -30,10 +30,20 @@ class OperationManager:
         else:
             chain.append(op)
 
-    def execute(self, response: Response,
-                entries: List[TensorTableEntry]) -> Status:
+    def select(self, response: Response,
+               entries: List[TensorTableEntry]):
+        """First enabled op for a response, or None — lets the dispatch
+        loop route device-plane work to the pipeline thread without
+        executing it."""
         for op in self._chains[response.response_type]:
             if op.enabled(response, entries):
-                return op.execute(response, entries)
-        return Status.error(
-            f"no enabled backend op for {response.response_type.name}")
+                return op
+        return None
+
+    def execute(self, response: Response,
+                entries: List[TensorTableEntry]) -> Status:
+        op = self.select(response, entries)
+        if op is None:
+            return Status.error(
+                f"no enabled backend op for {response.response_type.name}")
+        return op.execute(response, entries)

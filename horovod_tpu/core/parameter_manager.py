@@ -127,7 +127,9 @@ class ParameterManager:
         self._samples_seen = 0
         self._step_in_sample = 0
         self._bytes_in_sample = 0
-        self._sample_start = time.monotonic()
+        # None until the first counted step (see update() clock notes);
+        # thereafter always the previous sample's close timestamp.
+        self._sample_start: Optional[float] = None
         self._best: Tuple[float, Tuple[int, float]] = (
             -1.0, (initial_fusion_bytes, initial_cycle_ms))
         self._done = False
@@ -161,21 +163,36 @@ class ParameterManager:
         (``parameter_manager.cc:148-159``), so only cycles that actually
         moved bytes count toward ``steps_per_sample`` — otherwise the
         background loop's empty ticks close zero-byte samples and the
-        tuner optimizes noise."""
+        tuner optimizes noise.
+
+        Clock discipline: a sample's clock starts when the PREVIOUS sample
+        closes (the timestamp of its last counted step), so N counted
+        steps are scored over N inter-step intervals.  Starting it at the
+        first counted step instead would bill N steps' bytes to N-1
+        intervals, inflating every score by N/(N-1) (2x at
+        steps_per_sample=2).  The first sample ever has no previous close,
+        so it keeps the first-counted-step start (and the residual
+        one-sample bias) rather than billing the arbitrary init→training
+        gap.  The flip side is accepted and uniform: a mid-run pause
+        between samples (eval, checkpoint) deflates the one sample that
+        follows it."""
         if not self.enabled or self._done or nbytes <= 0:
             return None
-        if self._step_in_sample == 0:
-            # First counted step: restart the clock so an idle gap
-            # between samples (eval pause, checkpoint) is not billed to
-            # this sample's bytes/sec.
+        if self._step_in_sample == 0 and self._sample_start is None:
+            # Very first counted step of the run: no previous close to
+            # anchor on.
             self._sample_start = time.monotonic()
         self._bytes_in_sample += nbytes
         self._step_in_sample += 1
         if self._step_in_sample < self.steps_per_sample:
             return None
 
-        elapsed = max(time.monotonic() - self._sample_start, 1e-6)
+        now = time.monotonic()
+        elapsed = max(now - self._sample_start, 1e-6)
         score = self._bytes_in_sample / elapsed
+        # This close is the NEXT sample's clock start (N steps scored over
+        # N intervals — the N/(N-1) de-bias).
+        self._sample_start = now
         params = (self._fusion_bytes / (1024.0 * 1024.0), self._cycle_ms)
         self._samples_seen += 1
         if self._log:
@@ -206,5 +223,4 @@ class ParameterManager:
 
         self._step_in_sample = 0
         self._bytes_in_sample = 0
-        # (the sample clock restarts on the next counted step, not here)
         return (self._fusion_bytes, self._cycle_ms)

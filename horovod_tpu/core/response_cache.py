@@ -147,14 +147,26 @@ class CoordinatorCache:
 
 
 class WorkerCacheMirror:
-    """Worker side: {key → bit} learned from ResponseList assignments."""
+    """Worker side: {key → bit} plus the full request template per bit,
+    learned from ResponseList assignments.
+
+    The template is what makes the zero-payload fast path possible: on a
+    fully-cached cycle the coordinator answers with the agreed bitvector
+    only, and each worker reconstructs the Responses locally from these
+    templates (``controller._responses_from_agreed_mask``) instead of
+    deserializing a broadcast ResponseList."""
 
     def __init__(self):
         self._by_key: Dict[Tuple, int] = {}
-        self._by_bit: Dict[int, Tuple] = {}
+        self._by_bit: Dict[int, Tuple[Tuple, Request]] = {}
 
     def hit(self, req: Request) -> Optional[int]:
         return self._by_key.get(cache_key(req))
+
+    def template(self, bit: int) -> Optional[Request]:
+        """Request template for a live bit (None if unknown/evicted)."""
+        entry = self._by_bit.get(bit)
+        return entry[1] if entry is not None else None
 
     def apply(self, assignments: List[Tuple[int, Request]],
               evicted_bits: List[int]) -> None:
@@ -166,14 +178,14 @@ class WorkerCacheMirror:
         for bit, template in assignments:
             key = cache_key(template)
             stale = self._by_bit.get(bit)
-            if stale is not None and stale != key:
-                self._by_key.pop(stale, None)
+            if stale is not None and stale[0] != key:
+                self._by_key.pop(stale[0], None)
             self._by_key[key] = bit
-            self._by_bit[bit] = key
+            self._by_bit[bit] = (key, template)
         for bit in evicted_bits:
-            key = self._by_bit.pop(bit, None)
-            if key is not None:
-                self._by_key.pop(key, None)
+            entry = self._by_bit.pop(bit, None)
+            if entry is not None:
+                self._by_key.pop(entry[0], None)
 
     def __len__(self) -> int:
         return len(self._by_key)

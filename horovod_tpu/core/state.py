@@ -61,6 +61,25 @@ class HorovodGlobalState:
         self.cycle_time_ms = env_mod.DEFAULT_CYCLE_TIME_MS
         self.background: Optional[threading.Thread] = None
         self.init_error: Optional[BaseException] = None
+        # Adaptive cycle timing: enqueues set this event so an idle loop
+        # wakes immediately instead of sleeping out the cycle; busy cycles
+        # skip the sleep entirely (spin-then-park — the cycle_time_ms knob,
+        # autotuned by the ParameterManager, becomes the IDLE backstop
+        # rather than a floor under every dispatch's latency).
+        self._wake = threading.Event()
+        self._last_cycle_had_work = False
+        # Pipelined negotiate/dispatch (double-buffered background loop):
+        # device-plane responses are handed to a dedicated dispatcher
+        # thread so cycle i+1's negotiation overlaps cycle i's XLA dispatch
+        # host work.  Host-TCP responses still execute inline (they share
+        # the mesh sockets with negotiation; interleaving would cross
+        # frames) after a drain barrier, preserving the identical-order
+        # dispatch invariant on every rank.
+        self._dispatch_queue: Optional[queue.SimpleQueue] = None
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._dispatch_inflight = 0
+        self._dispatch_cv = threading.Condition()
+        self.pipeline_dispatch = True
         self.timeline = None  # attached by core.timeline when enabled
         self.parameter_manager = None  # attached when autotune enabled
         self.cycle_count = 0
@@ -88,6 +107,11 @@ class HorovodGlobalState:
         self._store = store
         self.cycle_time_ms = env_mod.get_float(
             env_mod.HOROVOD_CYCLE_TIME, env_mod.DEFAULT_CYCLE_TIME_MS)
+        # Pipelining pays only when there is negotiation latency to hide;
+        # at size 1 it would just add a thread hop per dispatch.
+        self.pipeline_dispatch = self.topo.size > 1 and env_mod.get_bool(
+            env_mod.HOROVOD_PIPELINE_DISPATCH, True)
+        self.tensor_queue.set_wake_event(self._wake)
         self.background = threading.Thread(
             target=self._background_loop, name="horovod-background", daemon=True)
         self.background.start()
@@ -112,6 +136,10 @@ class HorovodGlobalState:
             xla_backend.context().initialize(topo)
         else:
             xla_backend.context().reset()
+        startup_timeout = env_mod.get_float(
+            env_mod.HOROVOD_MESH_STARTUP_TIMEOUT, 60.0)
+        epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+        store = None
         if topo.size == 1:
             self.mesh = None
         else:
@@ -126,15 +154,13 @@ class HorovodGlobalState:
                 store = HTTPStoreClient(addr, port)
             # Epoch-scoped keys so elastic re-init never reads stale peer
             # addresses from a previous incarnation of the job.
-            epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
             # Check-in mark for the launcher's --start-timeout watchdog
             # (reference: workers surface through the rendezvous server and
             # horovodrun aborts if they don't within the timeout).
             store.set("worker_started", str(topo.rank), b"1")
             self.mesh = TcpMesh(
                 topo.rank, topo.size, store, scope=f"tcp.{epoch}",
-                timeout=env_mod.get_float(
-                    env_mod.HOROVOD_MESH_STARTUP_TIMEOUT, 60.0))
+                timeout=startup_timeout)
         fusion = env_mod.get_int(
             env_mod.HOROVOD_FUSION_THRESHOLD, env_mod.DEFAULT_FUSION_THRESHOLD)
         stall_secs = 0 if env_mod.get_bool(env_mod.HOROVOD_STALL_CHECK_DISABLE) \
@@ -161,6 +187,8 @@ class HorovodGlobalState:
             cache_capacity=env_mod.get_int(env_mod.HOROVOD_CACHE_CAPACITY,
                                            env_mod.DEFAULT_CACHE_CAPACITY),
             parameter_manager=self.parameter_manager)
+        if store is not None:
+            self._sync_controller_topology(store, epoch, startup_timeout)
         timeline_path = env_mod.get_str(env_mod.HOROVOD_TIMELINE)
         if timeline_path:
             # Reference writes the timeline only on the coordinator
@@ -174,6 +202,39 @@ class HorovodGlobalState:
                         env_mod.HOROVOD_TIMELINE_MARK_CYCLES))
                 self.controller.timeline = self.timeline
         self._register_default_ops()
+
+    def _sync_controller_topology(self, store, epoch: int,
+                                  timeout: float) -> None:
+        """Publish rank 0's negotiated controller fan-out through the
+        rendezvous store and validate every worker against it.
+
+        The star/tree choice is derived per-rank from
+        ``HOROVOD_CONTROLLER_TOPOLOGY``; a multi-host launch with partial
+        env propagation could give ranks different answers, and a
+        star-vs-tree mismatch deadlocks the first negotiation round with no
+        diagnostic (each side recv-blocks on a peer that will never send).
+        Making rank 0's choice authoritative-and-checked turns that silent
+        hang into a loud bring-up error naming the env fix."""
+        scope = f"controller.{epoch}"
+        chosen = self.controller.fanout_topology
+        if self.topo.rank == 0:
+            store.set(scope, "topology", chosen.encode())
+            return
+        try:
+            agreed = store.wait(scope, ["topology"],
+                                timeout=timeout)["topology"].decode()
+        except Exception as e:  # noqa: BLE001
+            raise HorovodInternalError(
+                f"rank {self.topo.rank} could not read rank 0's controller "
+                f"topology from the rendezvous store: {e}") from e
+        if agreed != chosen:
+            raise HorovodInternalError(
+                f"controller topology mismatch: rank 0 negotiates over "
+                f"{agreed!r} but rank {self.topo.rank} derived {chosen!r} "
+                f"from its environment — HOROVOD_CONTROLLER_TOPOLOGY (or "
+                f"world size) differs across ranks; propagate the same "
+                f"value to every host (a star/tree mismatch would deadlock "
+                f"the first negotiation round)")
 
     def _register_default_ops(self) -> None:
         topo, mesh = self.topo, self.mesh
@@ -241,20 +302,36 @@ class HorovodGlobalState:
         try:
             while True:
                 start = time.monotonic()
+                # Clear BEFORE popping: an add landing between pop and a
+                # clear-afterwards would lose its wakeup.
+                self._wake.clear()
                 if not self._run_loop_once():
                     break
-                # Re-read each cycle: the autotuner may retune it mid-run.
+                if self._last_cycle_had_work:
+                    # Spin: a busy cycle usually has an immediate follow-up
+                    # (the next microbatch, unfused stragglers) — skip the
+                    # sleep and negotiate again at once.  The blocking TCP
+                    # recv provides the backstop: an eager rank parks in
+                    # the kernel waiting for its peers, it does not burn
+                    # CPU.
+                    continue
+                # Idle: park on the wake event with the (autotuned) cycle
+                # time as the backstop, so an enqueue starts the next
+                # negotiation immediately instead of after the residue of
+                # a fixed sleep.
                 cycle = self.cycle_time_ms / 1000.0
                 elapsed = time.monotonic() - start
                 if elapsed < cycle:
-                    time.sleep(cycle - elapsed)
+                    self._wake.wait(cycle - elapsed)
         except BaseException as e:  # noqa: BLE001
             log.error("background loop died: %s", e, exc_info=True)
+            self._stop_dispatcher()
             self._fail_all_pending(str(e))
         else:
             # Clean shutdown must also unblock waiters: entries that never
             # negotiated get SHUT_DOWN_ERROR-style callbacks, like the
             # reference draining the tensor table on shutdown.
+            self._stop_dispatcher()
             self._fail_all_pending("Horovod has been shut down")
         finally:
             if self._finalizer_pool is not None:
@@ -269,11 +346,27 @@ class HorovodGlobalState:
 
     def _run_loop_once(self) -> bool:
         """One cycle (``RunLoopOnce``, ``operations.cc:595-689``): negotiate,
-        then execute every agreed response. Returns False to stop."""
+        then execute every agreed response. Returns False to stop.
+
+        Device-plane responses are handed to the dispatcher thread so this
+        loop can start negotiating the next cycle while cycle i's XLA
+        dispatch host work runs — the double-buffered schedule.  Everything
+        else (host-TCP collectives, which share the mesh with negotiation;
+        JOIN/ERROR/BARRIER bookkeeping) executes inline behind a drain
+        barrier so the cross-rank execution order stays identical."""
+        from .timeline import phase_stats
+
         requests = self.tensor_queue.pop_messages()
+        t0 = time.monotonic()
         response_list = self.controller.compute_response_list(
             requests, self.shutdown_requested.is_set())
         self.cycle_count += 1
+        self._last_cycle_had_work = bool(requests) \
+            or bool(response_list.responses)
+        if self._last_cycle_had_work:
+            # Busy cycles only: timing idle lockstep parks would swamp the
+            # negotiate lane with waiting, not negotiating.
+            phase_stats.add("negotiate", time.monotonic() - t0)
         if response_list.tuned_params is not None:
             # Autotuner moved (reference SynchronizeParameters): adopt the
             # broadcast cycle time on every rank.
@@ -281,11 +374,118 @@ class HorovodGlobalState:
         if self.timeline is not None:
             self.timeline.mark_cycle()
         for response in response_list.responses:
-            self._perform_operation(response)
-        return not response_list.shutdown
+            if self.pipeline_dispatch and self._device_plane_response(response):
+                self._dispatch_async(response)
+            else:
+                self._dispatch_drain()
+                self._perform_operation(response)
+        if response_list.shutdown:
+            return False
+        return True
 
-    def _perform_operation(self, response: Response) -> None:
-        """``PerformOperation`` analog (``operations.cc:256-336``)."""
+    def _device_plane_response(self, response: Response) -> bool:
+        """True when this response will execute on the XLA device plane
+        (safe to dispatch from the pipeline thread: it never touches the
+        TCP mesh the negotiation loop is using).  Mirrors the op chain's
+        enabled() preconditions; any response this misjudges simply takes
+        the inline path after a drain — correctness is unaffected, only
+        overlap."""
+        from ..backend import xla as xla_backend
+
+        if response.response_type not in (
+                ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
+                ResponseType.BROADCAST, ResponseType.ALLTOALL,
+                ResponseType.ADASUM):
+            return False
+        if response.devices != [xla_backend.XLA_DEVICE_ID]:
+            return False
+        if not xla_backend.context().ready:
+            return False
+        if self.joined:
+            # Zero-substituted entries are host buffers; the op chain will
+            # fall back to the TCP ring on this rank.
+            return False
+        if response.response_type == ResponseType.ADASUM:
+            p = self.topo.size
+            if p & (p - 1):
+                return False  # XlaAdasum needs a power-of-two world
+        return True
+
+    # -- pipelined dispatcher -------------------------------------------
+
+    def _dispatch_async(self, response: Response) -> None:
+        if self._dispatch_thread is None or not self._dispatch_thread.is_alive():
+            self._dispatch_queue = queue.SimpleQueue()
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop, name="horovod-dispatch",
+                daemon=True)
+            self._dispatch_thread.start()
+        with self._dispatch_cv:
+            self._dispatch_inflight += 1
+        self._dispatch_queue.put(response)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            response = self._dispatch_queue.get()
+            if response is None:
+                return
+            try:
+                self._perform_operation(response, require_device=True)
+            except BaseException as e:  # noqa: BLE001 — the negotiation
+                # loop must survive a dispatch failure; entries' callbacks
+                # already fired with an error inside _perform_operation for
+                # op-level faults, so anything reaching here is
+                # infrastructure — surface it like an async device error.
+                log.error("pipelined dispatch failed: %s", e, exc_info=True)
+                self.async_error = f"pipelined dispatch failed: {e}"
+            finally:
+                with self._dispatch_cv:
+                    self._dispatch_inflight -= 1
+                    if self._dispatch_inflight == 0:
+                        self._dispatch_cv.notify_all()
+
+    def _dispatch_drain(self, timeout: float = 300.0,
+                        must_drain: bool = True) -> None:
+        """Barrier: wait until every queued device dispatch has been issued
+        (NOT until the device finished — completion stays with the
+        finalizer).  Precedes any inline execution so the per-rank
+        dispatch order stays the negotiated order.
+
+        A drain timeout with ``must_drain`` RAISES: proceeding would run a
+        host op out of order against a still-queued device dispatch and
+        silently desync the cross-rank dispatch sequence — a loud loop
+        failure (which fails every pending entry) is strictly better."""
+        with self._dispatch_cv:
+            drained = self._dispatch_cv.wait_for(
+                lambda: self._dispatch_inflight == 0, timeout=timeout)
+        if not drained and must_drain:
+            raise HorovodInternalError(
+                f"pipelined dispatch did not drain within {timeout:.0f}s "
+                f"({self._dispatch_inflight} responses still in flight); "
+                "refusing to execute a host op out of dispatch order")
+
+    def _stop_dispatcher(self) -> None:
+        # Shutdown path: a wedged dispatch must not mask the original
+        # failure — log and move on rather than raise.
+        try:
+            self._dispatch_drain(timeout=60.0)
+        except HorovodInternalError as e:
+            log.error("dispatcher did not drain at shutdown: %s", e)
+        if self._dispatch_thread is not None \
+                and self._dispatch_thread.is_alive():
+            self._dispatch_queue.put(None)
+            self._dispatch_thread.join(timeout=10)
+        self._dispatch_thread = None
+
+    def _perform_operation(self, response: Response,
+                           require_device: bool = False) -> None:
+        """``PerformOperation`` analog (``operations.cc:256-336``).
+
+        ``require_device`` is set on the pipelined-dispatch path: a
+        response routed there must execute on the XLA plane — running a
+        host-TCP op from the dispatcher thread would interleave frames
+        with the concurrent negotiation on the same mesh sockets, so a
+        mis-route fails the entries cleanly instead of executing."""
         if response.response_type == ResponseType.JOIN:
             self.joined = False
             if self.join_event is not None:
@@ -318,6 +518,18 @@ class HorovodGlobalState:
                     aligned.append(cpu_ring.zero_entry_for(response, i, 0, n))
             entries = aligned
 
+        if require_device:
+            from ..backend.xla import XlaOp
+
+            op = self.op_manager.select(response, entries)
+            if not isinstance(op, XlaOp):
+                for e in entries:
+                    self._fire_callback(e, Status.error(
+                        "pipelined dispatch expected a device-plane op for "
+                        f"{response.response_type.name} but the chain "
+                        f"selected {type(op).__name__}; host ops cannot run "
+                        "concurrently with negotiation"))
+                return
         if self.timeline is not None:
             self.timeline.op_start(response, entries)
         try:

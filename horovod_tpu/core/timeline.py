@@ -128,3 +128,56 @@ class Timeline:
             return
         self._file.write("\n]\n")
         self._file.close()
+
+
+# ---------------------------------------------------------------------------
+# per-phase dispatch-chain accounting
+# ---------------------------------------------------------------------------
+
+
+class PhaseStats:
+    """Always-on wall-time accumulator over the eager dispatch chain's
+    phases: ``negotiate`` (controller round, busy cycles only), ``fuse``
+    (staging the fused buffer onto the mesh), ``collective`` (host cost of
+    dispatching the device collective), ``unfuse`` (slicing results back to
+    per-entry outputs), ``wait`` (framework-thread handle synchronization).
+
+    This is the aggregate companion to the Chrome-trace timeline: the trace
+    answers "what happened when", this answers "where does a dispatch's
+    millisecond budget go" cheaply enough to leave enabled (a few monotonic
+    reads + one dict update per phase per response).  Surfaced by
+    ``benchmarks/eager_bench.py --profile`` / ``eager_np_bench.py
+    --profile`` and snapshot-able from tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc: Dict[str, List[float]] = {}
+
+    def add(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            slot = self._acc.get(phase)
+            if slot is None:
+                self._acc[phase] = [seconds, 1]
+            else:
+                slot[0] += seconds
+                slot[1] += 1
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                phase: {
+                    "total_ms": round(total * 1e3, 3),
+                    "count": int(count),
+                    "mean_ms": round(total / count * 1e3, 4),
+                }
+                for phase, (total, count) in self._acc.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._acc.clear()
+
+
+#: Process-global instance — the background loop, the XLA backend, and the
+#: framework-side handle waits all record into this.
+phase_stats = PhaseStats()

@@ -217,12 +217,20 @@ def _reinit_xla_plane(topo) -> None:
     # Tear the OLD world's runtime down whenever one exists — including a
     # shrink to size 1, where a leftover distributed client would keep
     # heartbeating a coordinator that may live on the dead host.
-    if jax.distributed.is_initialized():
+    if xla_backend.jax_distributed_initialized():
         from jax._src import xla_bridge
 
         jax.distributed.shutdown()
         jax.clear_caches()
-        xla_bridge._clear_backends()
+        try:
+            # Supported path first (also invalidates pjit/device caches);
+            # fall back to the private bridge hook on jax versions where
+            # jax.extend lacks it.
+            import jax.extend.backend
+
+            jax.extend.backend.clear_backends()
+        except (ImportError, AttributeError):
+            xla_bridge._clear_backends()
     elif plane != "xla":
         return  # auto mode never had a device plane; keep TCP
 
@@ -236,6 +244,18 @@ def _reinit_xla_plane(topo) -> None:
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=topo.size,
                                process_id=topo.rank)
+    # Verify the NEW world actually took: a stale backend surviving the
+    # clear (or a straggler thread rebuilding it mid-teardown) would
+    # otherwise poison every later jax call with the OLD topology and
+    # surface as a confusing mismatch deep inside core init.  Fail fast
+    # and specific here instead; the run wrapper's retry tears down again.
+    if jax.process_count() != topo.size or \
+            jax.process_index() != topo.rank:
+        raise HorovodInternalError(
+            f"jax.distributed re-init did not take: jax reports "
+            f"{jax.process_index()}/{jax.process_count()} but the new "
+            f"world is {topo.rank}/{topo.size} (stale backend survived "
+            f"teardown)")
 
 
 def negotiate_jax_coordinator(topo) -> str:

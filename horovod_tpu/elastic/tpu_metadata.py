@@ -75,11 +75,18 @@ class TpuMetadataDiscovery(HostDiscovery):
       event → listed.
     - **preempted / terminating** — dropped immediately (GCE gives ~30-60 s
       of notice; the sooner the epoch turns, the less work is lost).
-    - **unreachable** — kept for ``unreachable_grace`` consecutive failed
-      polls, then dropped.  A preempted VM usually stops answering before
-      (or instead of) flipping the flag, so unreachability IS the common
-      preemption signal — but a single dropped packet must not churn the
-      membership.
+    - **unreachable** (timeout / no route) — kept for ``unreachable_grace``
+      consecutive failed polls, then dropped.  A preempted VM usually
+      stops answering before (or instead of) flipping the flag, so
+      unreachability IS the common preemption signal — but a single
+      dropped packet must not churn the membership.
+    - **relay-down** (connection refused) — kept indefinitely.  A refused
+      connection means the host's TCP stack answered with a RST: the VM
+      is alive, only the relay process on it has died.  Evicting a
+      healthy worker because its *monitoring* plane crashed would shrink
+      the job on a non-failure; instead the host stays in the membership
+      and the condition is logged (supervise the relay — see
+      ``docs/elastic.md``).
     """
 
     def __init__(self, hosts: List[HostInfo],
@@ -98,12 +105,29 @@ class TpuMetadataDiscovery(HostDiscovery):
         self._timeout = timeout
         self._grace = unreachable_grace
         self._fail_counts: Dict[str, int] = defaultdict(int)
+        self._relay_down_counts: Dict[str, int] = defaultdict(int)
         self._pool = ThreadPoolExecutor(
             max_workers=min(max_pollers, max(1, len(hosts))),
             thread_name_prefix="tpu-metadata-poll")
         self._lock = threading.Lock()
 
     # -- per-host probe -------------------------------------------------
+
+    @staticmethod
+    def _is_refused(exc: BaseException) -> bool:
+        """True when the failure is a TCP connection refusal — the host's
+        network stack actively answered (RST), so the VM is alive and only
+        the relay endpoint is closed.  Timeouts and no-route errors give
+        no such liveness evidence and stay 'unreachable'."""
+        e, seen = exc, set()
+        while isinstance(e, BaseException) and id(e) not in seen:
+            seen.add(id(e))
+            if isinstance(e, ConnectionRefusedError):
+                return True
+            # URLError wraps the socket error in .reason, not __cause__.
+            e = e.reason if isinstance(e, urllib.error.URLError) \
+                else e.__cause__
+        return False
 
     def _host_state(self, host: str) -> str:
         base = self._url.format(host=host)
@@ -116,7 +140,19 @@ class TpuMetadataDiscovery(HostDiscovery):
             if event.startswith(_TERMINAL_EVENTS):
                 return "terminating"
             return "ok"
+        except urllib.error.HTTPError as e:
+            # An HTTP status (relay 502: its local metadata fetch failed;
+            # any 5xx) is a live HTTP server answering from the host —
+            # even stronger liveness evidence than a RST.  The monitoring
+            # plane is degraded, the host is not.
+            log.debug("metadata relay on %s answered HTTP %s: %s",
+                      host, e.code, e)
+            return "relay-down"
         except (urllib.error.URLError, OSError, ValueError) as e:
+            if self._is_refused(e):
+                log.debug("metadata relay on %s refused connection: %s",
+                          host, e)
+                return "relay-down"
             log.debug("metadata poll for %s failed: %s", host, e)
             return "unreachable"
 
@@ -143,6 +179,22 @@ class TpuMetadataDiscovery(HostDiscovery):
                             "as gone", host, self._fail_counts[host])
                     continue
                 self._fail_counts[host] = 0
+                if state == "relay-down":
+                    # Host alive (TCP RST came back), monitoring relay
+                    # dead: never evict on a monitoring-plane failure —
+                    # keep the host, nag periodically so someone restarts
+                    # the relay (it should run supervised; docs/elastic.md).
+                    self._relay_down_counts[host] += 1
+                    if self._relay_down_counts[host] % 10 == 1:
+                        log.warning(
+                            "host %s is reachable but its metadata relay "
+                            "refuses connections (%d consecutive polls); "
+                            "keeping the host — preemption notices from it "
+                            "are BLIND until the relay is restarted",
+                            host, self._relay_down_counts[host])
+                    available[host] = slots
+                    continue
+                self._relay_down_counts[host] = 0
                 if state == "ok":
                     available[host] = slots
                 else:

@@ -34,8 +34,20 @@ def _maybe_init_jax_distributed(topology: Optional[ProcessTopology]) -> None:
         return
     import jax
 
-    if jax.distributed.is_initialized():
+    if xla_backend.jax_distributed_initialized():
         return
+    # CPU worlds (tests, virtual meshes) need jax's Gloo-backed CPU
+    # collectives or every cross-process computation aborts with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Must be set before the CPU client is created; harmless when the
+    # flag doesn't exist (ancient jax) or is already set.
+    if (os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+            or str(getattr(jax.config, "jax_platforms", "") or "")
+            .lower() == "cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — flag absent or backend latched
+            pass
     coord = env_mod.get_str(env_mod.HOROVOD_JAX_COORDINATOR)
     if not coord and env_mod.get_bool(env_mod.HOROVOD_ELASTIC):
         # Elastic jobs negotiate the coordinator through the rendezvous

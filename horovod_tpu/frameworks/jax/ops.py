@@ -226,4 +226,28 @@ def poll(handle: int) -> bool:
 
 def synchronize(handle: int, timeout: Optional[float] = None):
     """Wait for an async op and return its result."""
-    return _handles.wait(handle, timeout=timeout)
+    import time
+
+    from ...core.timeline import phase_stats
+
+    t0 = time.monotonic()
+    try:
+        return _handles.wait(handle, timeout=timeout)
+    finally:
+        phase_stats.add("wait", time.monotonic() - t0)
+
+
+def synchronize_many(handles, timeout: Optional[float] = None) -> list:
+    """Wait for a batch of async ops; returns results in handle order.
+
+    One wait per fused bucket instead of one per tensor — the batch flavor
+    the DistributedOptimizer/WFBP step paths use."""
+    import time
+
+    from ...core.timeline import phase_stats
+
+    t0 = time.monotonic()
+    try:
+        return _handles.wait_many(handles, timeout=timeout)
+    finally:
+        phase_stats.add("wait", time.monotonic() - t0)

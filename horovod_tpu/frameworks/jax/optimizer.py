@@ -131,8 +131,9 @@ def _allreduce_tree_per_leaf(grads, op, compression, prescale_factor,
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     names = _leaf_names(grads)
     handles, ctxs = [], []
-    # Enqueue everything first (async) so the runtime can fuse; then wait —
-    # the WFBP analog: comm of leaf i overlaps enqueue/compress of i+1.
+    # Enqueue everything first (async) so the runtime can fuse; then one
+    # batched wait over the lot — the WFBP analog: comm of leaf i overlaps
+    # enqueue/compress of i+1, and the step blocks once, not per tensor.
     for leaf, name in zip(leaves, names):
         comp, ctx = compression.compress(leaf)
         ctxs.append(ctx)
@@ -140,8 +141,8 @@ def _allreduce_tree_per_leaf(grads, op, compression, prescale_factor,
             comp, name=f"{name_prefix}.{name}", op=op,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor))
-    out = [compression.decompress(ops.synchronize(h), ctx)
-           for h, ctx in zip(handles, ctxs)]
+    out = [compression.decompress(r, ctx)
+           for r, ctx in zip(ops.synchronize_many(handles), ctxs)]
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

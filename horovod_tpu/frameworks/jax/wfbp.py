@@ -112,14 +112,20 @@ def enqueue_tree_fused(grads, op, compression, prescale_factor,
     ``optimizer._allreduce_tree``).  Returns immediately; the background
     runtime negotiates/dispatches while the caller computes the next
     microbatch's backward.  Finish with :func:`wait_tree`."""
+    import time
+
     import jax
     import jax.numpy as jnp
+
+    from ...core.timeline import phase_stats
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     sig = tuple((tuple(l.shape), jnp.asarray(l).dtype.name) for l in leaves)
     groups, flatten, unflatten = _fuse_plan(sig)
 
+    t0 = time.monotonic()
     bufs = flatten(leaves)
+    phase_stats.add("fuse", time.monotonic() - t0)
     handles, ctxs = [], []
     for buf, (dt, idxs) in zip(bufs, groups):
         comp, cctx = compression.compress(buf)
@@ -133,11 +139,16 @@ def enqueue_tree_fused(grads, op, compression, prescale_factor,
 
 
 def wait_tree(pending: PendingTree):
-    """Synchronize a :class:`PendingTree`; returns the reduced pytree."""
+    """Synchronize a :class:`PendingTree`; returns the reduced pytree.
+
+    One batched wait over the fused buckets (``ops.synchronize_many``)
+    instead of a per-handle loop — a step blocks once per fused bucket,
+    never once per tensor."""
     import jax
 
-    reduced = tuple(pending.compression.decompress(ops.synchronize(h), c)
-                    for h, c in zip(pending.handles, pending.ctxs))
+    results = ops.synchronize_many(pending.handles)
+    reduced = tuple(pending.compression.decompress(r, c)
+                    for r, c in zip(results, pending.ctxs))
     out = pending.unflatten(reduced, pending.leaves)
     return jax.tree_util.tree_unflatten(pending.treedef, out)
 

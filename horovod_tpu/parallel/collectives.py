@@ -96,13 +96,21 @@ def barrier_value(axis_name: AxisNames) -> jax.Array:
     return lax.psum(jnp.ones((), jnp.int32), axis_name)
 
 
+def _one_axis_size(a: str) -> int:
+    # lax.axis_size only exists in newer jax; psum of the literal 1 folds
+    # to the static axis size at trace time on every version.
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(a)
+    return lax.psum(1, a)
+
+
 def axis_size(axis_name: AxisNames) -> int:
     if isinstance(axis_name, (tuple, list)):
         size = 1
         for a in axis_name:
-            size *= lax.axis_size(a)
+            size *= _one_axis_size(a)
         return size
-    return lax.axis_size(axis_name)
+    return _one_axis_size(axis_name)
 
 
 def axis_index(axis_name: str) -> jax.Array:

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size
 from .mesh import AXIS_EXPERT
 
 
@@ -37,7 +38,7 @@ def moe_dispatch_combine(x: jax.Array, gate_logits: jax.Array,
     Returns ``[t, d]``: gate-weighted expert outputs (dropped tokens get 0,
     callers add the residual).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     t, d = x.shape
     if capacity is None:
         capacity = max(1, int(capacity_factor * t / n))

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size
 from .mesh import AXIS_PIPE
 
 
@@ -39,7 +40,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     (the last stage's results are broadcast back so downstream loss code
     is stage-agnostic).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m = microbatches.shape[0]
     perm = [(i, (i + 1) % n) for i in range(n)]

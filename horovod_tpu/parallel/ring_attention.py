@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size
 from .mesh import AXIS_SEQ
 
 
@@ -66,7 +67,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``[i*seq_shard, (i+1)*seq_shard)``).  Returns the local output shard in
     q's dtype.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     skv = k.shape[1]

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size
 from .mesh import AXIS_SEQ
 
 
@@ -65,7 +66,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     may be any full-sequence attention (e.g. a pallas flash kernel); the
     default is plain softmax attention.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by the seq axis "

@@ -21,30 +21,70 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # ---------------------------------------------------------------------------
 # port reservation (de-flake: the bind(0)-close-reuse idiom races the OS
 # ephemeral allocator — another process can grab the port in the window
-# between close and the worker's bind).  Hand out ports from BELOW the
-# ephemeral range (Linux default 32768+), where only explicit binders
-# live, advancing a probed counter so sequential tests never reuse.
+# between close and the worker's bind).  Two defenses, layered:
+#
+# 1. **Pid-partitioned range**: the 20000-32000 below-ephemeral band is
+#    split into disjoint per-process slices (pid % N picks the slice), so
+#    two concurrent pytest processes walk non-overlapping counters instead
+#    of colliding via the old pid*137%9000 seeding.
+# 2. **Held reservations**: the probe socket stays BOUND until handoff —
+#    from reservation to the moment workers are spawned, no other process
+#    can bind the port at all.  `release_reservations()` closes them
+#    immediately before the spawn; the residual window is
+#    spawn→worker-bind only, inside a slice no other test process
+#    allocates from.  The probe deliberately does NOT set SO_REUSEADDR:
+#    the option is per-socket (it would not transfer to the consumer), and
+#    with it the probe could bind a TIME_WAIT port that the consumer then
+#    cannot.  Bound-never-connected sockets leave no TIME_WAIT behind, so
+#    holding and releasing costs nothing.
+
+_PORT_BAND_LO, _PORT_BAND_HI = 20000, 32000
+_SLICES = 24
+_SLICE_LEN = (_PORT_BAND_HI - _PORT_BAND_LO) // _SLICES  # 500 ports each
 
 _port_counter: Optional[int] = None
+_held_reservations: Dict[int, socket.socket] = {}
+
+
+def _slice_bounds() -> tuple:
+    lo = _PORT_BAND_LO + (os.getpid() % _SLICES) * _SLICE_LEN
+    return lo, lo + _SLICE_LEN
 
 
 def reserve_port() -> int:
+    """Reserve a port from this process's slice, HOLDING the bound socket
+    open until :func:`release_reservations` (called by run_distributed at
+    spawn time, and safe to call directly)."""
     global _port_counter
+    lo, hi = _slice_bounds()
     if _port_counter is None:
-        _port_counter = 20000 + (os.getpid() * 137) % 9000
-    for _ in range(2000):
+        _port_counter = lo
+    for _ in range(_SLICE_LEN):
         _port_counter += 1
-        if _port_counter >= 32000:
-            _port_counter = 20001
+        if _port_counter >= hi:
+            _port_counter = lo + 1
+        if _port_counter in _held_reservations:
+            continue
         s = socket.socket()
         try:
             s.bind(("127.0.0.1", _port_counter))
         except OSError:
-            continue
-        finally:
             s.close()
+            continue
+        _held_reservations[_port_counter] = s
         return _port_counter
-    raise RuntimeError("no free port in the reserved range")
+    raise RuntimeError("no free port in this process's reserved slice")
+
+
+def release_reservations() -> None:
+    """Close every held reservation socket — the handoff point, called
+    right before worker processes are spawned so the consumer can bind."""
+    while _held_reservations:
+        _, s = _held_reservations.popitem()
+        try:
+            s.close()
+        except OSError:
+            pass
 
 
 def scaled_mesh_startup_timeout() -> str:
@@ -191,6 +231,9 @@ def _run_distributed_once(n: int, body: str, timeout: float,
                           local_size: Optional[int]) -> List[str]:
     from horovod_tpu.runner.rendezvous import RendezvousServer
 
+    # Handoff point for reserved ports (e.g. the jax coordinator port in
+    # extra_env): close the held sockets so the workers can bind them.
+    release_reservations()
     server = RendezvousServer(bind_addr="127.0.0.1")
     port = server.start()
     script = PREAMBLE + body + ("" if expect_failure else EPILOGUE)

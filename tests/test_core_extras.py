@@ -144,6 +144,31 @@ class TestParameterManager:
         assert pm._bo._ys, "sample was not observed"
         assert abs(pm._bo._ys[-1] - 2000.0) < 1.0, pm._bo._ys
 
+    def test_sample_clock_pins_unbiased_rate(self, monkeypatch):
+        """Regression for the ADVICE r5 N/(N-1) bias: from sample 2 on,
+        the clock anchors at the PREVIOUS sample's close, so N counted
+        steps score over N inter-step intervals.  The old first-step
+        restart scored this scenario at 2000 bytes/s (2x) instead of
+        1000."""
+        from horovod_tpu.core import parameter_manager as pm_mod
+
+        now = [0.0]
+        monkeypatch.setattr(pm_mod.time, "monotonic", lambda: now[0])
+        pm = ParameterManager(enabled=True, warmup_samples=0,
+                              steps_per_sample=2, max_samples=8)
+        # sample 1: counted steps at t=1, 2 (first-ever sample keeps the
+        # first-step clock start — no earlier close exists)
+        for t in (1.0, 2.0):
+            now[0] = t
+            pm.update(nbytes=1000)
+        # sample 2: counted steps at t=3, 4 → 2000 bytes over the two
+        # intervals since the t=2 close = exactly 1000 bytes/s.
+        for t in (3.0, 4.0):
+            now[0] = t
+            pm.update(nbytes=1000)
+        assert pm._bo._ys, "sample 2 was not observed"
+        assert abs(pm._bo._ys[-1] - 1000.0) < 1e-6, pm._bo._ys
+
     def test_autotune_log_csv_artifact(self, tmp_path):
         """--autotune-log-file emits the per-sample CSV record family the
         reference writes via HOROVOD_AUTOTUNE_LOG

@@ -35,11 +35,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 class FakeMetadataServer:
     """Per-host GCE instance metadata: GET /{host}/computeMetadata/v1/
-    instance/{key}.  Hosts marked down return 503 (unreachable-ish)."""
+    instance/{key}.  Hosts marked down drop the connection without an
+    HTTP response (a real down host gives no HTTP answer at all; an HTTP
+    error status now classifies as relay-down/host-alive, not
+    unreachable)."""
 
     def __init__(self):
         self.states = {}          # host -> {"preempted": .., "maintenance-event": ..}
-        self.down = set()
+        self.down = set()         # no HTTP answer at all (host gone)
+        self.broken = set()       # relay alive but erroring (HTTP 502)
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -50,8 +54,14 @@ class FakeMetadataServer:
                     self.send_error(404)
                     return
                 host, key = parts[0], parts[-1]
+                if host in outer.broken:
+                    self.send_error(502, "metadata fetch failed")
+                    return
                 if host in outer.down or host not in outer.states:
-                    self.send_error(503, "host gone")
+                    # Simulate true unreachability: no HTTP response at
+                    # all (close the TCP connection under the client).
+                    self.close_connection = True
+                    self.connection.close()
                     return
                 body = outer.states[host].get(key, "NONE").encode()
                 self.send_response(200)
@@ -134,6 +144,58 @@ def test_unreachable_grace_then_removed(meta):
     # recovery clears the strike counter
     meta.down.discard("b")
     assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+
+
+@pytest.mark.smoke
+def test_relay_down_connection_refused_never_evicts():
+    """A crashed relay answers with a TCP RST (connection refused): the
+    host is alive, only its monitoring plane died — it must stay in the
+    membership indefinitely, not be evicted after the unreachable grace
+    (a monitoring-plane failure shrinking the job was the ADVICE r5
+    finding)."""
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()  # nothing listens: connects are refused
+    disc = TpuMetadataDiscovery(
+        [HostInfo("a", 2)],
+        url_template=("http://127.0.0.1:%d/{host}/computeMetadata/v1/"
+                      "instance" % dead_port),
+        unreachable_grace=1, timeout=1.0)
+    # Far past the unreachable grace (1): still listed every poll.
+    for _ in range(5):
+        assert disc.find_available_hosts_and_slots() == {"a": 2}
+
+
+@pytest.mark.smoke
+def test_relay_http_error_keeps_host(meta):
+    """A relay answering HTTP 5xx (its upstream metadata fetch failing)
+    is a LIVE server on the host — host stays in the membership past any
+    grace, like connection-refused."""
+    disc = _discovery(meta, unreachable_grace=1)
+    meta.broken.add("b")
+    for _ in range(4):
+        assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+    meta.broken.discard("b")
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+
+
+def test_refused_detection_unwraps_urlerror():
+    """URLError carries the socket error in .reason, not __cause__; the
+    classifier must find ConnectionRefusedError through either chain and
+    stay False for timeouts/no-route."""
+    import urllib.error
+
+    is_refused = TpuMetadataDiscovery._is_refused
+    assert is_refused(ConnectionRefusedError(111, "refused"))
+    assert is_refused(
+        urllib.error.URLError(ConnectionRefusedError(111, "refused")))
+    assert not is_refused(urllib.error.URLError(TimeoutError()))
+    assert not is_refused(OSError("no route to host"))
+    assert not is_refused(
+        urllib.error.HTTPError("u", 503, "gone", None, None))
 
 
 @pytest.mark.smoke

@@ -239,6 +239,11 @@ print("XLA_ADASUM4_OK", rank, flush=True)
         assert f"XLA_ADASUM4_OK {r}" in o
 
 
+@pytest.mark.skipif(not hasattr(jax.lax, "ragged_all_to_all"),
+                    reason="this jax has no lax.ragged_all_to_all: the "
+                           "deterministic pre-check flips the fallback "
+                           "before any dispatch, which is the correct "
+                           "behavior but leaves nothing to exercise here")
 def test_ragged_fallback_only_on_capability_errors():
     """VERDICT r3 weak #4: a transient dispatch fault (e.g. OOM) must NOT
     flip the sticky ragged→bucketed fallback — on one rank only, that
