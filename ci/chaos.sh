@@ -1,0 +1,26 @@
+#!/bin/sh
+# Chaos lane (mirrors ci/real_integrations.sh): runs the fault-injection
+# suite standalone — deterministic kill/hang/drop/starve faults against
+# np=2/np=4 worker jobs, asserting the no-hang property (coordinated
+# errors on all survivors, or a successful elastic recovery) under
+# per-test wall-clock bounds.
+#
+#   sh ci/chaos.sh [extra pytest args...]
+#
+# Needs only the repo's baseline deps (jax + numpy + pytest); the faults
+# are injected via HOROVOD_FAULT_SPEC inside each test, so the lane is
+# self-contained.  A hang here is a failed TEST, not a wedged lane: every
+# chaos test carries a @pytest.mark.timeout SIGALRM watchdog
+# (tests/conftest.py) on top of the harness's own subprocess timeouts.
+set -eu
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+# No `... | tee` here: plain sh has no pipefail, so a pipeline would
+# swallow pytest's exit status and always report PASSED.
+rc=0
+JAX_PLATFORMS=cpu python -m pytest tests/test_fault_injection.py -m chaos \
+    -v -p no:cacheprovider "$@" > ci/chaos.last.log 2>&1 || rc=$?
+cat ci/chaos.last.log
+[ "$rc" -eq 0 ] || { echo "chaos lane FAILED (rc=$rc)"; exit "$rc"; }
+echo "chaos lane PASSED"

@@ -1,8 +1,11 @@
 from . import env  # noqa: F401
 from .exceptions import (  # noqa: F401
+    CoordinatedAbortError,
     DuplicateNameError,
+    FaultInjectedError,
     HorovodInternalError,
     HostsUpdatedInterrupt,
+    PeerGoneError,
     StalledTensorError,
     TensorShapeError,
 )

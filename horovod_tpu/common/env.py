@@ -32,6 +32,20 @@ HOROVOD_MESH_STARTUP_TIMEOUT = "HOROVOD_MESH_STARTUP_TIMEOUT"
 HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+# -- failure plane --
+# Bounded-deadline transport: a mesh recv that makes no byte progress for
+# this many seconds marks the peer dead and raises PeerGoneError (0 =
+# disabled, block forever like pre-hardening).  Arms only after a peer's
+# FIRST bytes — bring-up staggering (slow XLA init on one host) is the
+# startup timeout's jurisdiction.  Generous default: cycles are continuous
+# even when idle, so legitimate inter-frame gaps are small, but a host
+# swapping hard can stall minutes.
+HOROVOD_TCP_PROGRESS_DEADLINE = "HOROVOD_TCP_PROGRESS_DEADLINE_SECS"
+# Deterministic fault injection spec (common/faults.py); unset = no-op.
+HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
+# Elastic blacklist cooldown: a blacklisted host rejoins the candidate
+# pool after this many seconds (0 = permanent, the reference behavior).
+HOROVOD_BLACKLIST_COOLDOWN_SECS = "HOROVOD_BLACKLIST_COOLDOWN_SECS"
 
 # -- core runtime tunables (reference common.h:64-91) --
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"  # bytes, default 64MB
@@ -71,6 +85,7 @@ DEFAULT_CYCLE_TIME_MS = 1.0
 DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_CHECK_TIME_SECONDS = 60
 DEFAULT_STALL_SHUTDOWN_TIME_SECONDS = 0  # disabled
+DEFAULT_TCP_PROGRESS_DEADLINE_SECS = 600.0
 
 
 def get_int(name: str, default: int) -> int:

@@ -45,3 +45,37 @@ class DuplicateNameError(ValueError):
 
 class StalledTensorError(RuntimeError):
     """A tensor stalled past the shutdown threshold (stall inspector)."""
+
+
+class PeerGoneError(HorovodInternalError):
+    """A mesh peer is dead: its connection failed or its recv progress
+    deadline expired.  After the first failure the peer is marked dead and
+    every subsequent transport call to it fails fast with this error
+    instead of re-blocking on a broken socket (``transport/tcp.py``)."""
+
+    def __init__(self, rank: int, reason: str = ""):
+        super().__init__(
+            f"peer rank {rank} is gone" + (f": {reason}" if reason else ""))
+        self.rank = rank
+        self.reason = reason
+
+
+class CoordinatedAbortError(HorovodInternalError):
+    """Another rank broadcast a job abort over the mesh (coordinated
+    failure propagation): a peer died, a deadline expired, or the stall
+    inspector shut the job down there.  Carries the origin's elastic epoch
+    so stale aborts from a pre-reset epoch are discarded at the transport
+    layer (``core/messages.py:AbortFrame``)."""
+
+    def __init__(self, epoch: int, origin_rank: int, reason: str):
+        super().__init__(
+            f"coordinated abort from rank {origin_rank} "
+            f"(epoch {epoch}): {reason}")
+        self.epoch = epoch
+        self.origin_rank = origin_rank
+        self.reason = reason
+
+
+class FaultInjectedError(HorovodInternalError):
+    """Raised by ``common/faults.py`` for ``action=raise`` — rides every
+    path a real collective failure does (elastic rollback included)."""

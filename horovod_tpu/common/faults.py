@@ -1,0 +1,238 @@
+"""Deterministic fault injection — the failure plane's test harness.
+
+The reference's failure story (StallInspector, ``stall_inspector.cc``;
+Elastic Horovod's blacklist/reset loop) was only ever exercised by real
+infrastructure accidents.  This module makes failures *injectable and
+reproducible*: named sites threaded through the hot paths fire configured
+actions on exact call counts, so CI can kill a rank mid-allreduce, hang a
+recv, or drop a negotiation frame and assert the survivors' behavior.
+
+Spec grammar (``HOROVOD_FAULT_SPEC``, clauses joined by ``;``)::
+
+    clause  := site[:key=value]...
+    site    := tcp.send | tcp.recv | controller.negotiate |
+               dispatch.collective | rendezvous.get | worker.spawn
+    keys    := rank=N       only fire on this Horovod rank
+               peer=N       only fire when the op targets this peer rank
+               nth=N        fire exactly on the N-th matching call (1-based)
+               after=N      fire on every matching call after the first N
+               action=NAME[,ARG]
+
+    actions := hang            block forever (a stuck syscall)
+               delay_ms,MS     sleep MS milliseconds, then proceed
+               raise           raise FaultInjectedError (HorovodInternalError)
+               raise_oserror   raise OSError(ECONNRESET) — a torn connection
+               exit[,CODE]     os._exit(CODE or 1) — a hard process death
+               drop            skip the operation (send-only; the caller
+                               silently discards the payload)
+
+Examples::
+
+    HOROVOD_FAULT_SPEC='tcp.recv:rank=1:after=3:action=hang'
+    HOROVOD_FAULT_SPEC='tcp.send:rank=2:nth=5:action=raise_oserror'
+    HOROVOD_FAULT_SPEC='dispatch.collective:action=delay_ms,500'
+
+Determinism: every clause keeps its own matching-call counter, so a given
+spec against a deterministic call sequence reproduces the same failure at
+the same point, run after run — no randomness anywhere.
+
+Zero overhead when unset: ``ACTIVE`` is False and every instrumented site
+guards with ``if faults.ACTIVE:`` — the cost of an unconfigured site is one
+module-attribute read, nothing else.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+import time
+from typing import List, Optional
+
+from .env import HOROVOD_FAULT_SPEC
+from .exceptions import FaultInjectedError
+
+SITES = (
+    "tcp.send",
+    "tcp.recv",
+    "controller.negotiate",
+    "dispatch.collective",
+    "rendezvous.get",
+    "worker.spawn",
+)
+
+_ACTIONS = ("hang", "delay_ms", "raise", "raise_oserror", "exit", "drop")
+
+#: Fast-path flag: False means no spec is configured and ``inject`` is
+#: never called (sites guard on it).
+ACTIVE = False
+
+_lock = threading.Lock()
+_clauses: List["_Clause"] = []
+
+
+class _Clause:
+    __slots__ = ("site", "rank", "peer", "nth", "after", "action",
+                 "action_arg", "calls", "fired")
+
+    def __init__(self, site: str, rank: Optional[int], peer: Optional[int],
+                 nth: Optional[int], after: Optional[int],
+                 action: str, action_arg: Optional[str]):
+        self.site = site
+        self.rank = rank
+        self.peer = peer
+        self.nth = nth
+        self.after = after
+        self.action = action
+        self.action_arg = action_arg
+        self.calls = 0       # matching calls seen so far
+        self.fired = False   # nth clauses fire once
+
+    def matches(self, site: str, rank: Optional[int],
+                peer: Optional[int]) -> bool:
+        if site != self.site:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        if self.peer is not None and peer != self.peer:
+            return False
+        return True
+
+    def should_fire(self) -> bool:
+        """Count a matching call; True when the action fires on it."""
+        self.calls += 1
+        if self.nth is not None:
+            if self.fired or self.calls != self.nth:
+                return False
+            self.fired = True
+            return True
+        if self.after is not None:
+            return self.calls > self.after
+        return True
+
+
+def _parse_clause(text: str) -> _Clause:
+    parts = [p for p in text.strip().split(":") if p]
+    if not parts:
+        raise ValueError(f"empty fault clause in spec: {text!r}")
+    site = parts[0].strip()
+    if site not in SITES:
+        raise ValueError(
+            f"unknown fault site {site!r}; known sites: {', '.join(SITES)}")
+    rank = peer = nth = after = None
+    action = "raise"
+    action_arg: Optional[str] = None
+    for field in parts[1:]:
+        if "=" not in field:
+            raise ValueError(
+                f"fault clause field {field!r} is not key=value "
+                f"(clause: {text!r})")
+        key, val = field.split("=", 1)
+        key = key.strip()
+        val = val.strip()
+        if key == "rank":
+            rank = int(val)
+        elif key == "peer":
+            peer = int(val)
+        elif key == "nth":
+            nth = int(val)
+            if nth < 1:
+                raise ValueError(f"nth must be >= 1 (clause: {text!r})")
+        elif key == "after":
+            after = int(val)
+        elif key == "action":
+            action, _, arg = val.partition(",")
+            action = action.strip()
+            action_arg = arg.strip() or None
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"unknown fault action {action!r}; known actions: "
+                    f"{', '.join(_ACTIONS)}")
+        else:
+            raise ValueError(
+                f"unknown fault clause key {key!r} (clause: {text!r})")
+    if nth is not None and after is not None:
+        raise ValueError(f"nth and after are exclusive (clause: {text!r})")
+    if action == "drop" and site != "tcp.send":
+        # Only a send can be dropped (the caller skips the write); every
+        # other site would silently ignore the drop — and a spec that
+        # injects nothing must fail loudly, not pass chaos tests vacuously.
+        raise ValueError(
+            f"action=drop is only valid for site tcp.send (clause: {text!r})")
+    return _Clause(site, rank, peer, nth, after, action, action_arg)
+
+
+def configure(spec: Optional[str]) -> None:
+    """(Re)parse a spec string; ``None``/empty disables injection.  Raises
+    ``ValueError`` on grammar errors — a mistyped spec must fail the job
+    loudly at startup, not silently inject nothing."""
+    global ACTIVE
+    with _lock:
+        _clauses.clear()
+        if spec:
+            for raw in spec.split(";"):
+                if raw.strip():
+                    _clauses.append(_parse_clause(raw))
+        ACTIVE = bool(_clauses)
+
+
+def reset() -> None:
+    """Disable injection and forget all counters (test teardown)."""
+    configure(None)
+
+
+def _default_rank() -> int:
+    try:
+        return int(os.environ.get("HOROVOD_RANK", "-1") or "-1")
+    except ValueError:
+        return -1
+
+
+def inject(site: str, rank: Optional[int] = None,
+           peer: Optional[int] = None) -> bool:
+    """Fire any matching clause for this call.
+
+    Returns True when the caller should DROP the operation (``action=drop``);
+    raising/hanging/exiting actions never return.  Sites guard the call with
+    ``if faults.ACTIVE:`` so the disabled path costs one attribute read.
+    """
+    if rank is None:
+        rank = _default_rank()
+    drop = False
+    fire: List[_Clause] = []
+    with _lock:
+        for clause in _clauses:
+            if clause.matches(site, rank, peer) and clause.should_fire():
+                fire.append(clause)
+    for clause in fire:
+        drop = _run_action(clause, site, rank) or drop
+    return drop
+
+
+def _run_action(clause: _Clause, site: str, rank: int) -> bool:
+    action = clause.action
+    where = f"{site} (rank {rank}, call {clause.calls})"
+    if action == "hang":
+        # A stuck syscall: never returns.  The surrounding job is expected
+        # to detect this via progress deadlines / stall shutdown and the
+        # chaos harness to kill the process.
+        while True:
+            time.sleep(60.0)
+    if action == "delay_ms":
+        time.sleep(float(clause.action_arg or "100") / 1000.0)
+        return False
+    if action == "raise":
+        raise FaultInjectedError(f"injected fault at {where}")
+    if action == "raise_oserror":
+        raise OSError(errno.ECONNRESET,
+                      f"injected connection reset at {where}")
+    if action == "exit":
+        os._exit(int(clause.action_arg or "1"))
+    if action == "drop":
+        return True
+    raise AssertionError(f"unreachable action {action!r}")
+
+
+# Parse the ambient spec at import: worker processes inherit
+# HOROVOD_FAULT_SPEC from the launcher env and self-configure.
+configure(os.environ.get(HOROVOD_FAULT_SPEC))

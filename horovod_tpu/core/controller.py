@@ -36,6 +36,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
+from ..common import faults
 from ..common.logging_util import get_logger
 from ..common.topology import ProcessTopology
 from ..transport.tcp import TcpMesh
@@ -189,6 +190,8 @@ class Controller:
                               should_shutdown: bool = False) -> ResponseList:
         """One synchronous negotiation round. All ranks must call this every
         cycle; the TCP recv provides the lockstep."""
+        if faults.ACTIVE:
+            faults.inject("controller.negotiate", rank=self.topo.rank)
         if self.topo.size == 1:
             return self._single_process_responses(requests, should_shutdown)
         if self.topo.rank == 0:

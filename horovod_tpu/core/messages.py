@@ -24,6 +24,7 @@ import numpy as np
 
 WIRE_MAGIC = 0x48564454  # "HVDT"
 MASK_MAGIC = 0x4B53414D  # "MASK" — steady-state fast-path frame
+ABORT_MAGIC = 0x54524241  # "ABRT" — coordinated-abort control frame
 
 
 class DataType(enum.IntEnum):
@@ -344,6 +345,44 @@ def is_mask_frame(data: bytes) -> bool:
     """True when ``data`` is a MaskFrame (vs RequestList/ResponseList)."""
     return len(data) >= 4 and \
         struct.unpack_from("<I", data)[0] == MASK_MAGIC
+
+
+@dataclass
+class AbortFrame:
+    """Coordinated-abort broadcast: the detecting rank tells every
+    surviving peer that the job is dead and why.
+
+    Rides the transport's *control-frame* channel (``transport/tcp.py``
+    marks the length header), so it can never be confused with in-flight
+    negotiation or tensor payload bytes.  Carries the elastic epoch: a
+    late abort from a pre-reset incarnation of the job must be discarded,
+    not kill the freshly re-rendezvoused world.
+    """
+
+    epoch: int = 0
+    origin_rank: int = 0
+    reason: str = ""
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        w.u32(ABORT_MAGIC)
+        w.i64(self.epoch)
+        w.i32(self.origin_rank)
+        w.string(self.reason)
+        return w.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "AbortFrame":
+        r = Reader(data)
+        if r.u32() != ABORT_MAGIC:
+            raise ValueError("bad abort-frame magic")
+        return AbortFrame(epoch=r.i64(), origin_rank=r.i32(),
+                          reason=r.string())
+
+
+def is_abort_frame(data: bytes) -> bool:
+    return len(data) >= 4 and \
+        struct.unpack_from("<I", data)[0] == ABORT_MAGIC
 
 
 @dataclass

@@ -27,7 +27,12 @@ import numpy as np
 
 from ..backend import cpu_ring
 from ..common import env as env_mod
-from ..common.exceptions import HorovodInternalError
+from ..common import faults
+from ..common.exceptions import (
+    CoordinatedAbortError,
+    HorovodInternalError,
+    PeerGoneError,
+)
 from ..common.logging_util import get_logger
 from ..common.topology import ProcessTopology, from_env
 from ..transport.store import HTTPStoreClient, MemoryStore, Store
@@ -160,7 +165,7 @@ class HorovodGlobalState:
             store.set("worker_started", str(topo.rank), b"1")
             self.mesh = TcpMesh(
                 topo.rank, topo.size, store, scope=f"tcp.{epoch}",
-                timeout=startup_timeout)
+                timeout=startup_timeout, epoch=epoch)
         fusion = env_mod.get_int(
             env_mod.HOROVOD_FUSION_THRESHOLD, env_mod.DEFAULT_FUSION_THRESHOLD)
         stall_secs = 0 if env_mod.get_bool(env_mod.HOROVOD_STALL_CHECK_DISABLE) \
@@ -325,6 +330,12 @@ class HorovodGlobalState:
                     self._wake.wait(cycle - elapsed)
         except BaseException as e:  # noqa: BLE001
             log.error("background loop died: %s", e, exc_info=True)
+            # Sticky failure (NCCL async-watchdog role): the NEXT enqueue on
+            # this rank raises the same error a synchronous failure would,
+            # so the elastic run_fn retry loop picks it up identically.
+            if self.async_error is None:
+                self.async_error = str(e)
+            self._broadcast_abort(e)
             self._stop_dispatcher()
             self._fail_all_pending(str(e))
         else:
@@ -343,6 +354,26 @@ class HorovodGlobalState:
             if self.timeline is not None:
                 self.timeline.close()
             self.shutdown_complete.set()
+
+    def _broadcast_abort(self, error: BaseException) -> None:
+        """Coordinated abort: tell every surviving peer WHY this rank's
+        loop died so they fail loudly with the original reason instead of
+        hanging (or timing out) on a silent mesh.  A received
+        CoordinatedAbortError is re-broadcast too — that is what propagates
+        an abort through tree-mode relays — but with the ORIGIN's identity
+        preserved; receivers already aborted ignore duplicates via their
+        mesh abort flag."""
+        if self.mesh is None:
+            return
+        try:
+            if isinstance(error, CoordinatedAbortError):
+                self.mesh.send_abort(error.reason, epoch=error.epoch,
+                                     origin_rank=error.origin_rank)
+            else:
+                self.mesh.send_abort(
+                    f"rank {self.topo.rank}: {error}")
+        except Exception as e:  # noqa: BLE001 — teardown must proceed
+            log.warning("abort broadcast failed: %s", e)
 
     def _run_loop_once(self) -> bool:
         """One cycle (``RunLoopOnce``, ``operations.cc:595-689``): negotiate,
@@ -486,6 +517,9 @@ class HorovodGlobalState:
         host-TCP op from the dispatcher thread would interleave frames
         with the concurrent negotiation on the same mesh sockets, so a
         mis-route fails the entries cleanly instead of executing."""
+        if faults.ACTIVE:
+            faults.inject("dispatch.collective",
+                          rank=self.topo.rank if self.topo else None)
         if response.response_type == ResponseType.JOIN:
             self.joined = False
             if self.join_event is not None:
@@ -534,6 +568,20 @@ class HorovodGlobalState:
             self.timeline.op_start(response, entries)
         try:
             status = self.op_manager.execute(response, entries)
+        except (PeerGoneError, CoordinatedAbortError) as e:
+            # A dead mesh is FATAL, not an entry-level error: if this rank
+            # kept cycling, its next negotiation frames would be consumed
+            # by peers still blocked mid-collective on the same sockets —
+            # positional framing desyncs and survivors read control bytes
+            # as tensor data.  Fail THIS response's entries first (they
+            # were already popped from the tensor queue, so the loop-death
+            # _fail_all_pending sweep cannot see them — skipping this
+            # strands their waiters), then re-raise so the background loop
+            # dies, broadcasts the coordinated abort, and fails everything
+            # still queued.
+            for en in entries:
+                self._fire_callback(en, Status.error(str(e)))
+            raise
         except HorovodInternalError as e:
             status = Status.error(str(e))
         except Exception as e:  # noqa: BLE001

@@ -11,8 +11,10 @@ from __future__ import annotations
 
 import subprocess
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
+from ..common import env as env_mod
 from ..common.logging_util import get_logger
 from ..runner.hosts import HostInfo, parse_hosts
 
@@ -58,23 +60,53 @@ class HostDiscoveryScript(HostDiscovery):
 
 class HostManager:
     """Tracks the current host set, stable ordering, and the blacklist
-    (reference ``discovery.py:79-121``)."""
+    (reference ``discovery.py:79-121``).
 
-    def __init__(self, discovery: HostDiscovery):
+    The blacklist supports a COOLDOWN (``HOROVOD_BLACKLIST_COOLDOWN_SECS``
+    or the constructor arg; 0 = permanent, the reference behavior): on a
+    preemptible TPU-VM fleet a host is usually "bad" only transiently —
+    preempted, rebooting, migrating — and a permanent blacklist shrinks
+    the pool monotonically until the job starves below min_np.  After the
+    cooldown the host rejoins the candidate pool; if it fails again it is
+    simply re-blacklisted (each strike restarts the clock)."""
+
+    def __init__(self, discovery: HostDiscovery,
+                 blacklist_cooldown: Optional[float] = None):
         self._discovery = discovery
         self._lock = threading.Lock()
         self._order: List[str] = []       # stable rank order
         self._slots: Dict[str, int] = {}
-        self._blacklist: Set[str] = set()
+        # hostname -> expiry (monotonic seconds; inf = permanent)
+        self._blacklist: Dict[str, float] = {}
+        self._cooldown = env_mod.get_float(
+            env_mod.HOROVOD_BLACKLIST_COOLDOWN_SECS, 0.0) \
+            if blacklist_cooldown is None else blacklist_cooldown
+
+    @staticmethod
+    def _now() -> float:
+        return time.monotonic()
 
     def blacklist(self, hostname: str) -> None:
+        expiry = self._now() + self._cooldown \
+            if self._cooldown > 0 else float("inf")
         with self._lock:
             if hostname not in self._blacklist:
-                log.warning("blacklisting host %s", hostname)
-                self._blacklist.add(hostname)
+                log.warning(
+                    "blacklisting host %s%s", hostname,
+                    f" for {self._cooldown:g}s" if self._cooldown > 0
+                    else " permanently")
+            self._blacklist[hostname] = expiry
+
+    def _expire_blacklist_locked(self) -> None:
+        now = self._now()
+        for host in [h for h, exp in self._blacklist.items() if exp <= now]:
+            log.warning("blacklist cooldown expired for host %s; it may "
+                        "rejoin the pool", host)
+            del self._blacklist[host]
 
     def is_blacklisted(self, hostname: str) -> bool:
         with self._lock:
+            self._expire_blacklist_locked()
             return hostname in self._blacklist
 
     @property
@@ -89,6 +121,7 @@ class HostManager:
         hosts append — rank assignments stay stable across growth."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
+            self._expire_blacklist_locked()
             found = {h: s for h, s in found.items()
                      if h not in self._blacklist}
             removed = [h for h in self._order if h not in found]

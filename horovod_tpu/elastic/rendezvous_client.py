@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import sys
 import time
 
@@ -35,16 +36,34 @@ def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
     store = HTTPStoreClient(addr, port)
     my_epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
 
+    # Exponential backoff with jitter (capped ~2 s): after a host failure
+    # EVERY surviving worker re-rendezvouses at once, and a fixed-period
+    # poll hammers the (possibly still restarting) store in lockstep.
+    # Store errors are tolerated — the server may be mid-restart — but the
+    # LAST one is carried into the TimeoutError so a dead store is
+    # diagnosable instead of reading like a driver that never published.
     deadline = time.monotonic() + timeout
+    delay = 0.05
+    last_err = None
     while True:
-        raw = store.get(RANK_AND_SIZE_SCOPE, _identity())
+        try:
+            raw = store.get(RANK_AND_SIZE_SCOPE, _identity())
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            raw = None
         if raw is not None:
             slot = json.loads(raw.decode())
             if slot.get("epoch", 0) > my_epoch:
                 break
         if time.monotonic() > deadline:
-            raise TimeoutError("no new rendezvous assignment within timeout")
-        time.sleep(0.25)
+            detail = f" (last store error: {last_err})" if last_err else ""
+            raise TimeoutError(
+                f"no new rendezvous assignment within {timeout:.0f}s"
+                f"{detail}")
+        # Jitter WITHIN the cap (0.5x-1x of delay): the cap is the real
+        # worst-case poll gap, not a number jitter can double.
+        time.sleep(delay * (0.5 + 0.5 * random.random()))
+        delay = min(delay * 2.0, 2.0)
 
     # Ack adoption so the driver stops re-notifying this identity.
     store.set("epoch_ack", _identity(), str(slot["epoch"]).encode())

@@ -136,7 +136,16 @@ def _slot_env(slot: SlotInfo, rdv_addr: str, rdv_port: int,
 def spawn_worker(slot: SlotInfo, command: List[str],
                  env: Dict[str, str]) -> subprocess.Popen:
     """Spawn one slot's worker: local exec or ssh; remote workers receive
-    the job's HMAC key over stdin (never argv — see _ssh_command)."""
+    the job's HMAC key over stdin (never argv — see _ssh_command).
+
+    Fault site ``worker.spawn`` fires per spawn attempt (static AND
+    elastic respawns route through here), matched on the SLOT's rank —
+    e.g. ``worker.spawn:rank=2:action=raise`` fails exactly rank 2's
+    launch."""
+    from ..common import faults
+
+    if faults.ACTIVE:
+        faults.inject("worker.spawn", rank=slot.rank)
     local = _is_local(slot.hostname)
     cmd = command if local else _ssh_command(slot, command, env)
     proc = subprocess.Popen(

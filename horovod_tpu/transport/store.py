@@ -144,6 +144,10 @@ class HTTPStoreClient(Store):
             pass
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
+        from ..common import faults
+
+        if faults.ACTIVE:
+            faults.inject("rendezvous.get")
         try:
             with self._open_with_retry(
                     self._request(scope, key, "GET")) as resp:

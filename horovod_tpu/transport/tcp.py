@@ -19,26 +19,90 @@ notification path can interleave safely.
 from __future__ import annotations
 
 import queue
+import select
 import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
-from ..common.exceptions import HorovodInternalError
+from ..common import faults
+from ..common.exceptions import (
+    CoordinatedAbortError,
+    HorovodInternalError,
+    PeerGoneError,
+)
+from ..common.logging_util import get_logger
 from .store import Store
+
+log = get_logger("horovod_tpu.transport.tcp")
 
 _HELLO = struct.pack("<I", 0x48564D54)  # "HVMT"
 _LEN = struct.Struct("<Q")
+# Top bit of the 8-byte length header marks a CONTROL frame (coordinated
+# abort).  In-band marking keeps control delivery ordered with data on the
+# same socket while staying unambiguous against arbitrary payload bytes —
+# no payload is ever 2^63 bytes long.
+_CTRL_FLAG = 1 << 63
+# How often a blocked recv wakes to check the mesh-wide abort flag and its
+# progress deadline.  Bounds abort-propagation latency for threads blocked
+# on a DIFFERENT peer's socket than the one the abort arrived on.
+_ABORT_POLL_SECS = 0.25
+
+
+class _ProgressStall(Exception):
+    """Internal: a recv made no byte progress within the deadline."""
+
+
+def _wait_ready(sock: socket.socket, timeout: float, write: bool) -> bool:
+    """poll(2)-based readiness wait: select(2) breaks past fd 1024 and
+    large meshes hold one socket per peer."""
+    fd = sock.fileno()
+    if fd < 0:
+        # Closed under us (mesh teardown racing a blocked op): surface
+        # as the socket error it is, not a ValueError from poll/select.
+        raise OSError("socket closed")
+    if hasattr(select, "poll"):
+        p = select.poll()
+        p.register(fd, select.POLLOUT if write else select.POLLIN)
+        return bool(p.poll(timeout * 1000.0))
+    sets = ([], [sock], []) if write else ([sock], [], [])
+    r, w, _ = select.select(*sets, timeout)
+    return bool(w if write else r)
+
+
+def _wait_readable(sock: socket.socket, timeout: float) -> bool:
+    return _wait_ready(sock, timeout, write=False)
+
+
+def _wait_writable(sock: socket.socket, timeout: float) -> bool:
+    return _wait_ready(sock, timeout, write=True)
 
 
 class _Peer:
-    __slots__ = ("sock", "send_lock", "recv_lock")
+    __slots__ = ("sock", "send_lock", "recv_lock", "dead", "ever_received")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
+        # Registered peers run NON-BLOCKING: both directions are driven by
+        # the poll loops in _send_bounded/_recv_bounded.  A blocking
+        # send(2) queues its ENTIRE buffer before returning, so no
+        # poll-first scheme can bound it once a live-but-wedged peer stops
+        # reading; non-blocking send returns partial/EAGAIN and the loop
+        # keeps the progress deadline and abort flag in charge.
+        sock.setblocking(False)
         self.send_lock = threading.Lock()
         self.recv_lock = threading.Lock()
+        # First send/recv failure marks the peer dead (reason string);
+        # every later call fails fast with PeerGoneError instead of
+        # re-blocking on the broken socket.
+        self.dead: Optional[str] = None
+        # The progress deadline ARMS on the first bytes ever received from
+        # this peer: post-handshake bring-up staggers legitimately (slow
+        # XLA init, store waits) and is covered by the startup timeout —
+        # "gone" is a judgment about a peer that WAS participating and
+        # stopped.
+        self.ever_received = False
 
 
 class TcpMesh:
@@ -47,13 +111,34 @@ class TcpMesh:
     def __init__(self, rank: int, size: int, store: Store,
                  scope: str = "tcp", bind_addr: str = "0.0.0.0",
                  advertise_addr: Optional[str] = None,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0,
+                 epoch: Optional[int] = None,
+                 progress_deadline: Optional[float] = None):
+        from ..common import env as env_mod
+
         self.rank = rank
         self.size = size
         self._peers: Dict[int, _Peer] = {}
         self._closed = False
         self._sr_thread: Optional[threading.Thread] = None
         self._sr_queue: Optional[queue.SimpleQueue] = None
+        # Elastic epoch stamped into abort frames; aborts from older epochs
+        # are discarded on receipt (a pre-reset straggler must not kill the
+        # re-rendezvoused world).
+        self.epoch = env_mod.get_int("HOROVOD_EPOCH", 0) \
+            if epoch is None else epoch
+        # Recv progress deadline (seconds; 0 disables): any bytes received
+        # reset it, so slow-but-alive peers never trip it — only a peer
+        # that stops sending entirely.
+        self.progress_deadline = env_mod.get_float(
+            env_mod.HOROVOD_TCP_PROGRESS_DEADLINE,
+            env_mod.DEFAULT_TCP_PROGRESS_DEADLINE_SECS) \
+            if progress_deadline is None else progress_deadline
+        # Mesh-wide abort state: (epoch, origin_rank, reason) once any link
+        # delivered (or this rank broadcast) a coordinated abort.  Blocked
+        # recvs observe it within _ABORT_POLL_SECS regardless of which
+        # socket they wait on.
+        self._abort: Optional[Tuple[int, int, str]] = None
         if size == 1:
             self._listener = None
             return
@@ -77,6 +162,7 @@ class TcpMesh:
 
         # Accept connections from higher ranks while dialing lower ranks.
         accept_err: List[BaseException] = []
+        self._accept_done = threading.Event()
         n_expected = size - 1 - rank
         acceptor = threading.Thread(
             target=self._accept_loop, args=(n_expected, accept_err, timeout),
@@ -93,7 +179,10 @@ class TcpMesh:
             self._peers[j] = _Peer(
                 self._dial_peer(j, endpoints, timeout))
 
-        acceptor.join(timeout=timeout)
+        # The acceptor thread stays alive past the quota to service late
+        # dial retries (see _accept_loop), so wait on its quota event, not
+        # the thread itself.
+        self._accept_done.wait(timeout=timeout)
         if accept_err:
             raise HorovodInternalError(f"tcp mesh accept failed: {accept_err[0]}")
         if len(self._peers) != size - 1:
@@ -252,6 +341,33 @@ class TcpMesh:
         sock.settimeout(None)
         return sock
 
+    def _accept_one(self, sock: socket.socket) -> bool:
+        """Handshake one inbound connection; True when a NEW peer was
+        registered (duplicates and misroutes are answered, then closed)."""
+        try:
+            _configure(sock)
+            sock.settimeout(5.0)
+            peer_rank, intended = self._check_hello(
+                _recv_exact(sock, self._hello_len()))
+            # Always answer with our identity so a misrouted dialer
+            # learns who it reached and falls through to its next
+            # candidate; only register connections MEANT for us.
+            sock.sendall(self._hello_blob(self.rank, peer_rank))
+            if intended != self.rank:
+                sock.close()
+                return False
+            sock.settimeout(None)
+        except (OSError, HorovodInternalError):
+            # Unauthenticated or malformed connection: drop it
+            # without counting toward the expected peer set.
+            sock.close()
+            return False
+        if peer_rank not in self._peers:
+            self._peers[peer_rank] = _Peer(sock)
+            return True
+        sock.close()
+        return False
+
     def _accept_loop(self, n_expected: int, err: List[BaseException],
                      timeout: float) -> None:
         try:
@@ -261,51 +377,211 @@ class TcpMesh:
                 self._listener.settimeout(
                     max(0.1, deadline - time.monotonic()))
                 sock, _ = self._listener.accept()
-                try:
-                    _configure(sock)
-                    sock.settimeout(5.0)
-                    peer_rank, intended = self._check_hello(
-                        _recv_exact(sock, self._hello_len()))
-                    # Always answer with our identity so a misrouted dialer
-                    # learns who it reached and falls through to its next
-                    # candidate; only register connections MEANT for us.
-                    sock.sendall(self._hello_blob(self.rank, peer_rank))
-                    if intended != self.rank:
-                        sock.close()
-                        continue
-                    sock.settimeout(None)
-                except (OSError, HorovodInternalError):
-                    # Unauthenticated or malformed connection: drop it
-                    # without counting toward the expected peer set.
-                    sock.close()
-                    continue
-                if peer_rank not in self._peers:
-                    self._peers[peer_rank] = _Peer(sock)
+                if self._accept_one(sock):
                     registered += 1
-                else:
-                    sock.close()
+            self._accept_done.set()
         except BaseException as e:  # surfaced by constructor
             err.append(e)
+            # Wake the constructor NOW: it waits on the event (the thread
+            # outlives the quota), and an accept failure must fail
+            # bring-up immediately, not after the full startup timeout.
+            self._accept_done.set()
+            return
+        # Quota filled — keep servicing LATE dial retries until close.
+        # Under load a dialer can abandon a half-done handshake (5 s
+        # hello timeout) that we already counted, then retry; with nobody
+        # accepting, that retry jams in the listen backlog and its rank
+        # blocks in connect until the job dies — the silent-hang flavor
+        # of the bring-up race.  Answering the hello (and closing the
+        # duplicate) turns it into a fast PeerGoneError on whichever
+        # socket lost, which the coordinated-abort plane then cleans up.
+        while not self._closed:
+            try:
+                self._listener.settimeout(1.0)
+                sock, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed (mesh teardown)
+            self._accept_one(sock)
 
     # -- framed messaging ---------------------------------------------------
+
+    def _check_alive(self, p: _Peer, peer: int) -> None:
+        if self._abort is not None:
+            raise CoordinatedAbortError(*self._abort)
+        if p.dead is not None:
+            raise PeerGoneError(peer, p.dead)
+
+    def _mark_dead(self, p: _Peer, reason: str) -> None:
+        if p.dead is None:
+            p.dead = reason
 
     def send(self, peer: int, payload: bytes) -> None:
         p = self._peer(peer)
         with p.send_lock:
+            self._check_alive(p, peer)
             try:
-                p.sock.sendall(_LEN.pack(len(payload)))
-                p.sock.sendall(payload)
+                if faults.ACTIVE and faults.inject(
+                        "tcp.send", rank=self.rank, peer=peer):
+                    return  # injected frame drop
+                self._send_bounded(p, _LEN.pack(len(payload)))
+                self._send_bounded(p, payload)
+            except _ProgressStall as e:
+                self._mark_dead(p, str(e))
+                raise PeerGoneError(peer, str(e)) from None
             except OSError as e:
-                raise HorovodInternalError(f"send to rank {peer} failed: {e}") from e
+                self._mark_dead(p, f"send to rank {peer} failed: {e}")
+                raise PeerGoneError(
+                    peer, f"send to rank {peer} failed: {e}") from e
+
+    def _send_bounded(self, p: _Peer, data: bytes) -> None:
+        """``sendall`` with the same failure-plane waits as the recv side:
+        a peer that is alive but has stopped READING (hung mid-step) fills
+        the socket buffer and a plain sendall would block forever — TCP
+        never errors on a live-but-idle peer.  Any bytes the peer's stack
+        accepts reset the progress clock; the mesh-wide abort flag is
+        observed every poll quantum.  No first-bytes arming needed: the
+        kernel accepts into the receive buffer even while the peer app is
+        still initializing, so bring-up stagger cannot trip this."""
+        sock = p.sock
+        view = memoryview(data)
+        sent = 0
+        budget = self.progress_deadline
+        deadline = (time.monotonic() + budget) if budget > 0 else None
+        while sent < len(data):
+            if self._abort is not None:
+                raise CoordinatedAbortError(*self._abort)
+            if not _wait_writable(sock, _ABORT_POLL_SECS):
+                if deadline is not None and time.monotonic() > deadline:
+                    raise _ProgressStall(
+                        f"no send progress for {budget:.0f}s "
+                        f"(HOROVOD_TCP_PROGRESS_DEADLINE_SECS={budget:g})")
+                continue
+            try:
+                r = sock.send(view[sent:])
+            except BlockingIOError:
+                continue  # lost the race to buffer space; re-poll
+            sent += r
+            if deadline is not None:
+                deadline = time.monotonic() + budget
 
     def recv(self, peer: int) -> bytes:
         p = self._peer(peer)
         with p.recv_lock:
+            self._check_alive(p, peer)
             try:
-                n = _LEN.unpack(_recv_exact(p.sock, _LEN.size))[0]
-                return _recv_exact(p.sock, n)
+                if faults.ACTIVE:
+                    faults.inject("tcp.recv", rank=self.rank, peer=peer)
+                while True:
+                    n = _LEN.unpack(self._recv_bounded(p, _LEN.size))[0]
+                    if n & _CTRL_FLAG:
+                        ctrl = self._recv_bounded(p, n & ~_CTRL_FLAG)
+                        self._handle_control(ctrl, peer)
+                        continue  # stale control frame: keep reading
+                    return self._recv_bounded(p, n)
+            except _ProgressStall as e:
+                self._mark_dead(p, str(e))
+                raise PeerGoneError(peer, str(e)) from None
             except OSError as e:
-                raise HorovodInternalError(f"recv from rank {peer} failed: {e}") from e
+                self._mark_dead(p, f"recv from rank {peer} failed: {e}")
+                raise PeerGoneError(
+                    peer, f"recv from rank {peer} failed: {e}") from e
+
+    def _recv_bounded(self, p: _Peer, n: int) -> bytes:
+        """``_recv_exact`` with the failure-plane waits: wakes every
+        ``_ABORT_POLL_SECS`` to observe a mesh-wide abort (which may have
+        arrived on a different peer's link) and enforces the progress
+        deadline — *any* bytes received reset it.  The deadline only
+        applies once the peer has EVER sent bytes (see ``_Peer``): the
+        first-ever frame may legitimately lag the whole bring-up stagger."""
+        sock = p.sock
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        budget = self.progress_deadline
+        deadline = (time.monotonic() + budget) \
+            if budget > 0 and p.ever_received else None
+        while got < n:
+            if self._abort is not None:
+                raise CoordinatedAbortError(*self._abort)
+            if not _wait_readable(sock, _ABORT_POLL_SECS):
+                if deadline is not None and time.monotonic() > deadline:
+                    raise _ProgressStall(
+                        f"no recv progress for {budget:.0f}s "
+                        f"(HOROVOD_TCP_PROGRESS_DEADLINE_SECS={budget:g})")
+                continue
+            try:
+                r = sock.recv_into(view[got:], n - got)
+            except BlockingIOError:
+                continue  # readable raced away (non-blocking socket)
+            if r == 0:
+                raise OSError("peer closed connection")
+            got += r
+            if not p.ever_received:
+                p.ever_received = True
+                if budget > 0:
+                    deadline = time.monotonic() + budget
+            elif deadline is not None:
+                deadline = time.monotonic() + budget
+        return bytes(buf)
+
+    def _handle_control(self, payload: bytes, peer: int) -> None:
+        """Returns normally only for STALE control frames (discard)."""
+        from ..core.messages import AbortFrame, is_abort_frame
+
+        if not is_abort_frame(payload):
+            raise HorovodInternalError(
+                f"unknown control frame from rank {peer}")
+        frame = AbortFrame.from_bytes(payload)
+        if frame.epoch < self.epoch:
+            log.warning(
+                "discarding stale abort from rank %d (epoch %d < %d): %s",
+                frame.origin_rank, frame.epoch, self.epoch, frame.reason)
+            return
+        self._abort = (frame.epoch, frame.origin_rank, frame.reason)
+        raise CoordinatedAbortError(frame.epoch, frame.origin_rank,
+                                    frame.reason)
+
+    def send_abort(self, reason: str, epoch: Optional[int] = None,
+                   origin_rank: Optional[int] = None) -> None:
+        """Broadcast a coordinated abort over every surviving link.
+
+        Best-effort and non-blocking-ish (bounded lock waits + socket
+        timeouts): the caller is already tearing down and must not hang on
+        a wedged peer.  Also flips this mesh's own abort flag so any local
+        thread still blocked in a recv (e.g. the sendrecv helper) unblocks
+        within one poll quantum.  ``origin_rank`` lets a RELAY of someone
+        else's abort keep the original detector's identity."""
+        if self._closed or self.size == 1:
+            return
+        from ..core.messages import AbortFrame
+
+        epoch = self.epoch if epoch is None else epoch
+        origin_rank = self.rank if origin_rank is None else origin_rank
+        payload = AbortFrame(epoch=epoch, origin_rank=origin_rank,
+                             reason=reason).to_bytes()
+        if self._abort is None:
+            self._abort = (epoch, origin_rank, reason)
+        for peer, p in list(self._peers.items()):
+            # Dead-marked links are still TRIED: a recv-deadline mark only
+            # proves the peer stopped sending — its recv direction may be
+            # fine (e.g. hung mid-step), and the abort is exactly what
+            # unblocks it.  A truly torn socket errors out immediately.
+            if not p.send_lock.acquire(timeout=2.0):
+                continue  # a wedged send holds the lock; skip this link
+            try:
+                p.sock.settimeout(5.0)
+                p.sock.sendall(_LEN.pack(len(payload) | _CTRL_FLAG))
+                p.sock.sendall(payload)
+            except OSError as e:
+                self._mark_dead(p, f"abort send failed: {e}")
+            finally:
+                try:
+                    p.sock.setblocking(False)  # peers stay non-blocking
+                except OSError:
+                    pass
+                p.send_lock.release()
 
     def sendrecv(self, send_to: int, payload: bytes, recv_from: int) -> bytes:
         """Concurrent send+recv — the ring-collective step primitive.
@@ -345,7 +621,15 @@ class TcpMesh:
             task = self._sr_queue.get()
             if task is None:
                 return
-            task()
+            try:
+                task()
+            except BaseException:  # noqa: BLE001 — a raising task must not
+                # kill the loop: tasks already queued behind it would never
+                # run and their callers would wait forever on completion
+                # events nobody sets.  (sendrecv's own task catches its
+                # errors into the result box; anything reaching here is a
+                # foreign/broken submission.)
+                log.error("sendrecv helper task raised", exc_info=True)
 
     def _peer(self, peer: int) -> _Peer:
         try:

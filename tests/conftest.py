@@ -48,6 +48,58 @@ def pytest_configure(config):
     open(os.environ["HVD_TEST_RETRY_LOG"], "w").close()
 
 
+def pytest_collection_modifyitems(config, items):
+    """Run chaos-marked tests LAST (stable sort: everything else keeps its
+    order).  The chaos lane is wall-clock-heavy multiprocess jobs; signal
+    from the fast functional tiers must never queue behind it, and
+    ``ci/chaos.sh`` runs the lane standalone anyway."""
+    items.sort(key=lambda it: it.get_closest_marker("chaos") is not None)
+
+
+class TestWatchdogTimeout(Exception):
+    """Raised in the test when its @pytest.mark.timeout bound expires."""
+
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Per-test wall-clock guard for @pytest.mark.timeout(N).
+
+    The chaos suite's whole point is the NO-HANG property: a regression
+    that hangs a worker must fail that one test, not wedge the suite until
+    the outer CI timeout kills everything.  SIGALRM interrupts the test in
+    the main thread (subprocess waits included); bounds are load-scaled
+    like every other suite timeout.  No-ops where SIGALRM is unavailable
+    or pytest-timeout is installed (which then owns the marker)."""
+    import signal
+    import threading
+
+    marker = item.get_closest_marker("timeout")
+    if (marker is None or not marker.args
+            or not hasattr(signal, "SIGALRM")
+            or item.config.pluginmanager.hasplugin("timeout")
+            or threading.current_thread() is not threading.main_thread()):
+        return (yield)
+    from .helpers import _timeout_scale
+
+    seconds = max(1, int(marker.args[0] * _timeout_scale()))
+
+    def _expired(signum, frame):
+        raise TestWatchdogTimeout(
+            f"test exceeded its {seconds}s watchdog bound "
+            f"(@pytest.mark.timeout({marker.args[0]}), load-scaled)")
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     path = os.environ.get("HVD_TEST_RETRY_LOG")
     lines = []

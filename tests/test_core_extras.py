@@ -6,6 +6,7 @@ the closed-form operator (`test_adasum_pytorch.py` style).
 """
 
 import numpy as np
+import pytest
 
 from horovod_tpu.core.messages import DataType, Request, RequestType
 from horovod_tpu.core.parameter_manager import (
@@ -199,6 +200,99 @@ class TestParameterManager:
         assert abs(float(bcycle) - pm.cycle_time_ms) < 0.01
         assert abs(float(bfusion)
                    - pm.fusion_threshold_bytes / 1048576.0) < 0.01
+
+
+class TestStallInspector:
+    """Coordinator-side stall inspector (``controller._check_stalls``):
+    the shutdown path, the mask-path cached-tensor flavor, and the
+    both-knobs-disabled early return."""
+
+    def _controller(self, warn=0.0, shut=0.0, size=3, cache=1024):
+        from horovod_tpu.common.topology import ProcessTopology
+        from horovod_tpu.core.controller import Controller
+
+        topo = ProcessTopology(rank=0, size=size, local_size=size)
+        return Controller(topo, mesh=None, stall_warning_secs=warn,
+                          stall_shutdown_secs=shut, cache_capacity=cache)
+
+    def _age_everything(self, ctrl, by: float) -> None:
+        """Backdate every stall clock so the next check sees `by` seconds
+        of age without the test sleeping."""
+        import time
+
+        past = time.monotonic() - by
+        ctrl._last_stall_check = past
+        for entry in ctrl._message_table.values():
+            entry.first_seen = past
+        for bit in list(ctrl._mask_bit_since):
+            ctrl._mask_bit_since[bit] = past
+
+    def test_both_knobs_disabled_early_return(self):
+        ctrl = self._controller(warn=0.0, shut=0.0)
+        ctrl._increment(_req(name="stuck", rank=1))
+        self._age_everything(ctrl, by=10_000.0)
+        before = ctrl._last_stall_check
+        ctrl._check_stalls()  # no raise, no clock advance: fully disabled
+        assert ctrl._last_stall_check == before
+        assert "stuck" in ctrl._message_table
+
+    def test_shutdown_path_names_tensor_and_missing_ranks(self):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+
+        ctrl = self._controller(warn=0.0, shut=5.0)
+        ctrl._increment(_req(name="grad/w0", rank=1))  # ranks 0,2 missing
+        self._age_everything(ctrl, by=6.0)
+        with pytest.raises(HorovodInternalError) as ei:
+            ctrl._check_stalls()
+        msg = str(ei.value)
+        assert "stall shutdown" in msg
+        assert "grad/w0" in msg
+        assert "[0, 2]" in msg, msg
+
+    def test_shutdown_independent_of_disabled_warning(self):
+        """Disabling warnings must not silently disable the hard abort."""
+        from horovod_tpu.common.exceptions import HorovodInternalError
+
+        ctrl = self._controller(warn=0.0, shut=1.0)
+        ctrl._increment(_req(name="t", rank=1))
+        self._age_everything(ctrl, by=2.0)
+        with pytest.raises(HorovodInternalError):
+            ctrl._check_stalls()
+
+    def test_mask_path_cached_stall_shutdown_names_tensor(self):
+        """A cache-bit announced by a subset of ranks ages past the
+        shutdown deadline: the abort must name the CACHED tensor (via the
+        coordinator cache template), not just a bit number."""
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        from horovod_tpu.core.response_cache import cache_key
+
+        ctrl = self._controller(warn=0.0, shut=5.0)
+        bit, _ = ctrl._cache.maybe_insert(_req(name="cached/t", rank=0))
+        ctrl._pending_masks[1] = 1 << bit  # rank 1 announced; 0,2 missing
+        ctrl._mask_bit_since[bit] = 0.0
+        self._age_everything(ctrl, by=6.0)
+        with pytest.raises(HorovodInternalError) as ei:
+            ctrl._check_stalls()
+        msg = str(ei.value)
+        assert "stall shutdown" in msg and "cached/t" in msg
+        assert "[0, 2]" in msg, msg
+
+    def test_mask_path_warning_converts_and_invalidates(self):
+        """Below shutdown but past warning, a stalled cached bit converts
+        its partial announcements into table tallies and evicts the cache
+        entry so a post-recovery resubmission renegotiates from scratch."""
+        ctrl = self._controller(warn=5.0, shut=0.0)
+        bit, _ = ctrl._cache.maybe_insert(_req(name="cached/w", rank=0))
+        ctrl._pending_masks[1] = 1 << bit
+        ctrl._mask_bit_since[bit] = 0.0
+        self._age_everything(ctrl, by=6.0)
+        ctrl._check_stalls()
+        # bit cleared from the mask path, tallied in the message table
+        assert bit not in ctrl._mask_bit_since
+        assert "cached/w" in ctrl._message_table
+        assert ctrl._message_table["cached/w"].ranks == {1}
+        # cache entry invalidated: the eviction is queued for broadcast
+        assert bit in ctrl._cycle_evictions
 
 
 def test_cache_steady_state_hits_and_correctness():

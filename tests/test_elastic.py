@@ -73,6 +73,50 @@ class TestHostManager:
         disc = HostDiscoveryScript(str(script))
         assert disc.find_available_hosts_and_slots() == {"hostA": 2, "hostB": 1}
 
+    def test_blacklist_cooldown_expires_and_host_rejoins(self, monkeypatch):
+        """With a cooldown, a blacklisted (e.g. transiently preempted)
+        host rejoins the pool after expiry instead of shrinking it
+        forever; a failure after rejoining re-blacklists with a fresh
+        clock."""
+        clock = [1000.0]
+        monkeypatch.setattr(HostManager, "_now",
+                            staticmethod(lambda: clock[0]))
+        disc = _MutableDiscovery({"a": 1, "b": 1})
+        mgr = HostManager(disc, blacklist_cooldown=30.0)
+        mgr.update_available_hosts()
+        mgr.blacklist("b")
+        assert mgr.is_blacklisted("b")
+        mgr.update_available_hosts()
+        assert [h.hostname for h in mgr.current_hosts] == ["a"]
+        # still excluded just before expiry
+        clock[0] += 29.0
+        assert mgr.is_blacklisted("b")
+        # past expiry: rejoins the pool
+        clock[0] += 2.0
+        assert not mgr.is_blacklisted("b")
+        changed, removal = mgr.update_available_hosts()
+        assert changed and not removal
+        assert [h.hostname for h in mgr.current_hosts] == ["a", "b"]
+        # re-blacklist restarts the clock
+        mgr.blacklist("b")
+        clock[0] += 29.0
+        assert mgr.is_blacklisted("b")
+        clock[0] += 2.0
+        assert not mgr.is_blacklisted("b")
+
+    def test_blacklist_default_is_permanent(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_BLACKLIST_COOLDOWN_SECS", raising=False)
+        disc = _MutableDiscovery({"a": 1, "b": 1})
+        mgr = HostManager(disc)
+        mgr.blacklist("b")
+        assert mgr._blacklist["b"] == float("inf")
+        assert mgr.is_blacklisted("b")
+
+    def test_blacklist_cooldown_env_knob(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_SECS", "45")
+        mgr = HostManager(_MutableDiscovery({"a": 1}))
+        assert mgr._cooldown == 45.0
+
 
 def test_worker_state_registry_barrier():
     reg = WorkerStateRegistry(2)

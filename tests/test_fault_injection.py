@@ -1,0 +1,311 @@
+"""Chaos suite: deterministic fault injection against the failure plane.
+
+Every subprocess test here asserts the NO-HANG property: with a fault spec
+killing, hanging, or starving a rank, all surviving ranks either raise a
+coordinated ``HorovodInternalError`` or complete an elastic recovery —
+within a hard wall-clock bound (the ``timeout`` marker's SIGALRM watchdog
+in conftest).  ``ci/chaos.sh`` runs this lane standalone.
+
+Spec grammar and site list: ``docs/fault_injection.md`` /
+``horovod_tpu/common/faults.py``.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common import faults
+from horovod_tpu.common.exceptions import FaultInjectedError
+
+from .helpers import REPO_ROOT, run_distributed
+
+pytestmark = pytest.mark.chaos
+
+# Chaos workers run with a short recv progress deadline so hang-flavored
+# faults convert to PeerGoneError within seconds, not the 600 s production
+# default.
+_FAST_DEADLINE = {"HOROVOD_TCP_PROGRESS_DEADLINE_SECS": "3"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Injection state must never leak between tests (or into the suite)."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# the injection registry itself (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultSpec:
+    def test_inactive_by_default(self):
+        assert not faults.ACTIVE
+        assert faults.inject("tcp.send", rank=0) is False
+
+    def test_grammar_errors_are_loud(self):
+        for bad in ["nosuch.site:action=raise",
+                    "tcp.send:action=explode",
+                    "tcp.send:frobnicate",
+                    "tcp.send:nth=0:action=raise",
+                    "tcp.send:nth=1:after=2:action=raise",
+                    # drop is send-only: anywhere else it would silently
+                    # inject nothing
+                    "tcp.recv:action=drop",
+                    "dispatch.collective:action=drop"]:
+            with pytest.raises(ValueError):
+                faults.configure(bad)
+
+    def test_rank_and_peer_filters(self):
+        faults.configure("tcp.send:rank=1:peer=2:action=drop")
+        assert faults.inject("tcp.send", rank=0, peer=2) is False
+        assert faults.inject("tcp.send", rank=1, peer=0) is False
+        assert faults.inject("tcp.recv", rank=1, peer=2) is False
+        assert faults.inject("tcp.send", rank=1, peer=2) is True
+
+    def test_nth_fires_exactly_once_deterministically(self):
+        for _ in range(2):  # same spec → same firing call, run after run
+            faults.configure("tcp.send:nth=3:action=drop")
+            fired = [faults.inject("tcp.send", rank=0) for _ in range(6)]
+            assert fired == [False, False, True, False, False, False]
+
+    def test_after_fires_on_every_later_call(self):
+        faults.configure("tcp.send:after=2:action=drop")
+        fired = [faults.inject("tcp.send", rank=0) for _ in range(5)]
+        assert fired == [False, False, True, True, True]
+
+    def test_counters_are_per_clause(self):
+        faults.configure(
+            "tcp.send:rank=0:nth=1:action=drop;tcp.send:rank=1:nth=2:action=drop")
+        assert faults.inject("tcp.send", rank=0) is True
+        assert faults.inject("tcp.send", rank=1) is False  # its own call #1
+        assert faults.inject("tcp.send", rank=1) is True
+
+    def test_raise_action(self):
+        faults.configure("controller.negotiate:action=raise")
+        with pytest.raises(FaultInjectedError, match="controller.negotiate"):
+            faults.inject("controller.negotiate", rank=0)
+
+    def test_raise_oserror_action(self):
+        faults.configure("rendezvous.get:action=raise_oserror")
+        with pytest.raises(OSError, match="injected connection reset"):
+            faults.inject("rendezvous.get")
+
+    def test_delay_action(self):
+        faults.configure("dispatch.collective:action=delay_ms,150")
+        t0 = time.monotonic()
+        assert faults.inject("dispatch.collective", rank=0) is False
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_hang_action_blocks(self):
+        faults.configure("tcp.recv:action=hang")
+        done = threading.Event()
+
+        def call():
+            faults.inject("tcp.recv", rank=0)
+            done.set()  # unreachable
+
+        threading.Thread(target=call, daemon=True).start()
+        assert not done.wait(0.3), "hang action returned"
+
+    def test_env_spec_parsed_in_fresh_process(self):
+        """Workers self-configure from HOROVOD_FAULT_SPEC at import."""
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from horovod_tpu.common import faults; print(faults.ACTIVE)"],
+            env={**os.environ,
+                 "HOROVOD_FAULT_SPEC": "tcp.send:nth=1:action=drop"},
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert out.stdout.strip() == "True", (out.stdout, out.stderr)
+
+
+# ---------------------------------------------------------------------------
+# chaos: subprocess worker jobs under injected faults
+# ---------------------------------------------------------------------------
+
+_SURVIVOR_BODY = """
+from horovod_tpu.common.exceptions import HorovodInternalError
+try:
+    for i in range(500):
+        hvd.allreduce(np.ones(32, np.float32), name=f"t{i % 4}")
+    print("NO_FAULT_SEEN", rank, flush=True)
+except HorovodInternalError as e:
+    print("SURVIVOR_ABORT", rank, str(e).replace("\\n", " "), flush=True)
+"""
+
+
+@pytest.mark.timeout(150)
+def test_kill_rank_mid_allreduce_np4_coordinated_abort():
+    """A rank hard-dying mid-collective (os._exit via the
+    dispatch.collective site) must surface as a coordinated
+    HorovodInternalError on EVERY survivor — not an eternal block in
+    recv."""
+    outs = run_distributed(
+        4, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_FAULT_SPEC":
+                       "dispatch.collective:rank=2:nth=2:action=exit,9"})
+    for r in (0, 1, 3):
+        assert f"SURVIVOR_ABORT {r}" in outs[r], (r, outs[r])
+    assert "SURVIVOR_ABORT 2" not in outs[2]  # the victim died, silently
+
+
+@pytest.mark.timeout(150)
+def test_hang_recv_np2_deadline_then_coordinated_abort():
+    """A rank wedged inside recv (bounded-hang flavor of ``action=hang``,
+    so the harness can also observe the VICTIM's recovery): the healthy
+    rank's progress deadline trips, it broadcasts the abort, and when the
+    victim unwedges it reads the abort frame instead of re-blocking."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.recv:rank=1:nth=3:action=delay_ms,8000"})
+    assert "SURVIVOR_ABORT 0" in outs[0], outs[0]
+    assert "no recv progress" in outs[0], outs[0]
+    assert "SURVIVOR_ABORT 1" in outs[1], outs[1]
+    # The victim's exact error depends on whether rank 0's process is
+    # still alive when it unwedges: it either reads the buffered abort
+    # frame (coordinated abort) or fails fast on the torn socket
+    # (PeerGoneError).  Both are clean errors; neither is a hang.
+    assert "coordinated abort from rank 0" in outs[1] \
+        or "peer rank 0 is gone" in outs[1], outs[1]
+
+
+@pytest.mark.timeout(150)
+def test_drop_negotiation_frame_np2_coordinated_abort():
+    """A silently-lost control-plane frame must not strand the job: the
+    coordinator sees no progress, marks the peer gone, aborts both
+    sides."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=5:action=drop"})
+    assert "SURVIVOR_ABORT 0" in outs[0], outs[0]
+    assert "SURVIVOR_ABORT 1" in outs[1], outs[1]
+
+
+@pytest.mark.timeout(150)
+def test_delayed_frames_complete_without_false_abort():
+    """Slow-but-alive must NOT abort: per-frame delays well under the
+    deadline reset the progress clock (any bytes count), and the job
+    completes normally."""
+    outs = run_distributed(
+        2, """
+for i in range(5):
+    out = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name=f"d{i}")
+    assert np.allclose(np.asarray(out), 2.0), out
+print("DELAY_OK", rank, flush=True)
+""", timeout=120, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:after=0:action=delay_ms,80"})
+    for r in range(2):
+        assert f"DELAY_OK {r}" in outs[r], outs[r]
+
+
+@pytest.mark.timeout(150)
+def test_stall_shutdown_np4_propagates_to_all_ranks():
+    """The stall inspector's hard abort must reach the ranks that DID
+    submit: the coordinator raises locally and the abort broadcast carries
+    the stall text (tensor + missing ranks) to every survivor."""
+    outs = run_distributed(
+        4, """
+import time
+from horovod_tpu.common.exceptions import HorovodInternalError
+if rank == 3:
+    time.sleep(8)    # never submits (must outlive the 3s stall deadline)
+else:
+    try:
+        hvd.allreduce(np.ones(4, np.float32), name="never")
+        print("STALL_NOT_DETECTED", rank, flush=True)
+    except HorovodInternalError as e:
+        print("STALL_ABORT", rank, str(e).replace("\\n", " "), flush=True)
+""", timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+                   "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "3"})
+    for r in (0, 1, 2):
+        assert f"STALL_ABORT {r}" in outs[r], (r, outs[r])
+        assert "stall shutdown" in outs[r], (r, outs[r])
+        assert "never" in outs[r], (r, outs[r])
+
+
+@pytest.mark.timeout(150)
+def test_rendezvous_failure_fails_init_fast():
+    """A dying rendezvous store during bring-up must fail init promptly on
+    every rank (HorovodInternalError out of hvd.init) — the no-hang bound
+    is this test's own watchdog."""
+    outs = run_distributed(
+        2, "", timeout=90, expect_failure=True, retries=0,
+        extra_env={"HOROVOD_FAULT_SPEC":
+                       "rendezvous.get:action=raise_oserror",
+                   "HOROVOD_MESH_STARTUP_TIMEOUT": "10"})
+    for out in outs:
+        assert "WORKER_OK" not in out  # init must have failed
+
+
+_ELASTIC_CHAOS_TRAIN = """
+import os, time
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0)
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 25:
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="g")
+        print(f"BATCH {state.batch} rank={hvd.rank()} size={hvd.size()}",
+              flush=True)
+        state.batch += 1
+        state.commit()
+        time.sleep(0.05)
+
+train(state)
+print("ELASTIC_DONE", hvd.rank(), flush=True)
+hvd.shutdown()
+"""
+
+
+@pytest.mark.timeout(300)
+def test_elastic_recovers_from_injected_rank_death(tmp_path):
+    """End-to-end: HOROVOD_FAULT_SPEC hard-kills rank 1 mid-run under the
+    elastic launcher; the survivor rolls back to its last commit,
+    re-rendezvouses at size 1, and finishes — an injected fault rides the
+    exact recovery path a real worker death does."""
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+    disc.chmod(0o755)
+    train = tmp_path / "train.py"
+    train.write_text(_ELASTIC_CHAOS_TRAIN)
+
+    env = os.environ.copy()
+    env.update(_FAST_DEADLINE)
+    # Fires only in rank 1's worker process (rank filter); the respawned
+    # world has no rank 1, so recovery runs fault-free.
+    env["HOROVOD_FAULT_SPEC"] = "dispatch.collective:rank=1:nth=8:action=exit,9"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        capture_output=True, timeout=240)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "ELASTIC_DONE" in proc.stdout, proc.stdout[-2000:]
+    assert "size=2" in proc.stdout, "never ran at full size"
+    assert "size=1" in proc.stdout, "never recovered at reduced size"

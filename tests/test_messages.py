@@ -76,6 +76,21 @@ def test_bad_magic_rejected():
         RequestList.from_bytes(b"\x00\x00\x00\x00\x00\x00\x00\x00")
 
 
+def test_abort_frame_roundtrip():
+    from horovod_tpu.core.messages import AbortFrame, is_abort_frame
+
+    frame = AbortFrame(epoch=3, origin_rank=2,
+                       reason="stall shutdown: tensor g, missing ranks [1]")
+    data = frame.to_bytes()
+    assert is_abort_frame(data)
+    assert not is_abort_frame(RequestList().to_bytes())
+    out = AbortFrame.from_bytes(data)
+    assert (out.epoch, out.origin_rank) == (3, 2)
+    assert "missing ranks [1]" in out.reason
+    with pytest.raises(ValueError):
+        AbortFrame.from_bytes(RequestList().to_bytes())
+
+
 @pytest.mark.parametrize("np_dtype", [
     np.uint8, np.int8, np.int32, np.int64, np.float16, np.float32,
     np.float64, np.bool_,
