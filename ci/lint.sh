@@ -1,0 +1,22 @@
+#!/bin/sh
+# Lint lane (mirrors ci/chaos.sh): the hvd-lint static pass over the
+# package plus its own test suite (per-rule fixtures, the zero-violation
+# tree contract, and the lockdep unit tests).  Fast — run it FIRST: a
+# reopened invariant (blocking call under a lock, typo'd fault site,
+# swallowed thread exception) fails here in seconds instead of wedging a
+# multiprocess job in the chaos lane.
+#
+#   sh ci/lint.sh [extra pytest args...]
+set -eu
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+rc=0
+{
+    python -m horovod_tpu.tools.lint horovod_tpu/ &&
+    JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py tests/test_lockdep.py \
+        -q -p no:cacheprovider "$@"
+} > ci/lint.last.log 2>&1 || rc=$?
+cat ci/lint.last.log
+[ "$rc" -eq 0 ] || { echo "lint lane FAILED (rc=$rc)"; exit "$rc"; }
+echo "lint lane PASSED"

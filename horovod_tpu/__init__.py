@@ -15,6 +15,16 @@ The default public API is the jax binding::
 
 from .version import __version__  # noqa: F401
 
+# Lockdep-style lock-order validation (common/lockdep.py), opt-in via
+# HOROVOD_LOCK_DEBUG=1.  Installed at import so launcher-spawned worker
+# processes (which inherit the env) are instrumented too — that is what
+# lets the multiprocess/chaos suites double as the deadlock detector's
+# workload.  Zero footprint when the knob is unset.
+from .common import lockdep as _lockdep  # noqa: E402
+
+if _lockdep.requested():
+    _lockdep.install()
+
 # The jax binding is the default flavor, mirroring how the reference exposes
 # `import horovod.torch as hvd`. Imported lazily so that `horovod_tpu.common`
 # stays importable in minimal environments.

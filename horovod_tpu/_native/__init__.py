@@ -24,6 +24,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..common import env as env_mod
 from ..common.logging_util import get_logger
 
 log = get_logger("horovod_tpu.native")
@@ -113,7 +114,10 @@ def lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("HOROVOD_DISABLE_NATIVE"):
+        # Plain non-empty truthiness (NOT get_bool): this knob has always
+        # meant "set to anything, including 0, to disable" and deployed
+        # pins must keep their meaning.
+        if env_mod.get_str(env_mod.HOROVOD_DISABLE_NATIVE):
             return None
         so = _so_path()
         if so is None:

@@ -249,7 +249,7 @@ class HierarchicalAllreduce(RingAllreduce):
     def applicable(topo: ProcessTopology) -> bool:
         from ..common import env as env_mod
 
-        if env_mod.get_str("HOROVOD_HIERARCHICAL_ALLREDUCE") in (
+        if env_mod.get_str(env_mod.HOROVOD_HIERARCHICAL_ALLREDUCE) in (
                 "0", "false", "False"):
             return False
         # The structural requirements are safety, not preference — a forced

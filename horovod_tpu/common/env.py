@@ -32,6 +32,23 @@ HOROVOD_MESH_STARTUP_TIMEOUT = "HOROVOD_MESH_STARTUP_TIMEOUT"
 HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+# Negotiation fan-out: "auto" | "star" | "tree" (core/controller.py picks
+# tree at the measured world-size crossover when auto).
+HOROVOD_CONTROLLER_TOPOLOGY = "HOROVOD_CONTROLLER_TOPOLOGY"
+
+# -- elastic membership --
+# Monotonic membership epoch, stamped by the elastic driver into every
+# worker env and bumped on each re-rendezvous; read via ``get_epoch()``.
+HOROVOD_EPOCH = "HOROVOD_EPOCH"
+HOROVOD_ELASTIC_RESET_LIMIT = "HOROVOD_ELASTIC_RESET_LIMIT"
+# Blacklist strike thresholds (elastic/constants.py holds the defaults):
+# crash exits use the low limit, TRANSIENT_EXIT_CODE exits the high one.
+HOROVOD_ELASTIC_CRASH_FAILURE_LIMIT = "HOROVOD_ELASTIC_CRASH_FAILURE_LIMIT"
+HOROVOD_ELASTIC_TRANSIENT_FAILURE_LIMIT = \
+    "HOROVOD_ELASTIC_TRANSIENT_FAILURE_LIMIT"
+# Override for the per-host GCE metadata relay URL template ({host}
+# placeholder required; elastic/tpu_metadata.py).
+HOROVOD_TPU_METADATA_URL = "HOROVOD_TPU_METADATA_URL"
 # -- failure plane --
 # Bounded-deadline transport: a mesh recv that makes no byte progress for
 # this many seconds marks the peer dead and raises PeerGoneError (0 =
@@ -46,6 +63,15 @@ HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
 # Elastic blacklist cooldown: a blacklisted host rejoins the candidate
 # pool after this many seconds (0 = permanent, the reference behavior).
 HOROVOD_BLACKLIST_COOLDOWN_SECS = "HOROVOD_BLACKLIST_COOLDOWN_SECS"
+# Lockdep-style runtime lock-order validator (common/lockdep.py): when
+# truthy, Lock/RLock created inside this package are instrumented and an
+# exit-time report names lock-order inversion cycles and blocking waits
+# performed while holding another lock.  Diagnostics only — never on in
+# production paths by default.
+HOROVOD_LOCK_DEBUG = "HOROVOD_LOCK_DEBUG"
+# Acquire waits longer than this (seconds) while holding another lock are
+# recorded as held-lock blocking waits in the lockdep report.
+HOROVOD_LOCK_DEBUG_SLOW_SECS = "HOROVOD_LOCK_DEBUG_SLOW_SECS"
 
 # -- core runtime tunables (reference common.h:64-91) --
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"  # bytes, default 64MB
@@ -69,6 +95,21 @@ HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOI
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_LOG_HIDE_TIMESTAMP = "HOROVOD_LOG_HIDE_TIMESTAMP"
 HOROVOD_ADASUM_MPI_CHUNK_SIZE = "HOROVOD_ADASUM_MPI_CHUNK_SIZE"
+# Force the hierarchical (intra-host ring + parallel cross-host rings)
+# allreduce off/on ("0"/"1"; reference common.h:79).  Structural
+# requirements still gate a forced "1" (backend/cpu_ring.py).
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+# Finalizer pool width (NUM_NCCL_STREAMS analog): concurrent in-flight
+# fused-batch completions (core/state.py).
+HOROVOD_NUM_FINALIZER_THREADS = "HOROVOD_NUM_FINALIZER_THREADS"
+# Truthy: never build/load the optional native kernel library
+# (_native/__init__.py).
+HOROVOD_DISABLE_NATIVE = "HOROVOD_DISABLE_NATIVE"
+# "1": use the pallas flash-attention kernel in models/transformer.py
+# (opt-in; measured slower than the XLA-fused einsum at moderate s).
+HOROVOD_FLASH_ATTENTION = "HOROVOD_FLASH_ATTENTION"
+# Row cap for the store-less (driver-collect) Spark fit path; 0 disables.
+HOROVOD_SPARK_INLINE_MAX_ROWS = "HOROVOD_SPARK_INLINE_MAX_ROWS"
 
 # -- TPU-specific (no reference equivalent: XLA data-plane knobs) --
 HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"  # e.g. "dp:8" or "dp:4,tp:2"
@@ -86,6 +127,8 @@ DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_CHECK_TIME_SECONDS = 60
 DEFAULT_STALL_SHUTDOWN_TIME_SECONDS = 0  # disabled
 DEFAULT_TCP_PROGRESS_DEADLINE_SECS = 600.0
+DEFAULT_SPARK_INLINE_MAX_ROWS = 100_000
+DEFAULT_LOCK_DEBUG_SLOW_SECS = 1.0
 
 
 def get_int(name: str, default: int) -> int:
@@ -111,3 +154,11 @@ def get_bool(name: str, default: bool = False) -> bool:
 
 def get_str(name: str, default: str = "") -> str:
     return os.environ.get(name, default)
+
+
+def get_epoch() -> int:
+    """Current elastic membership epoch (0 outside elastic jobs).
+
+    Every consumer of ``HOROVOD_EPOCH`` goes through here so the default
+    lives in exactly one place."""
+    return get_int(HOROVOD_EPOCH, 0)

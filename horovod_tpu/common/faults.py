@@ -49,7 +49,7 @@ import threading
 import time
 from typing import List, Optional
 
-from .env import HOROVOD_FAULT_SPEC
+from .env import HOROVOD_FAULT_SPEC, HOROVOD_RANK
 from .exceptions import FaultInjectedError
 
 SITES = (
@@ -183,7 +183,7 @@ def reset() -> None:
 
 def _default_rank() -> int:
     try:
-        return int(os.environ.get("HOROVOD_RANK", "-1") or "-1")
+        return int(os.environ.get(HOROVOD_RANK, "-1") or "-1")
     except ValueError:
         return -1
 

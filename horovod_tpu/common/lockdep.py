@@ -1,0 +1,471 @@
+"""lockdep — a runtime lock-order validator for the Python control plane.
+
+The Linux kernel's lockdep keys every lock to its *allocation site* (its
+"lock class"), records the order in which classes are taken per thread,
+and reports the moment two threads ever disagree on that order — no
+actual deadlock needs to occur.  The reference Horovod gets the
+equivalent from C++ TSan in CI; our control plane is pure Python, so the
+validator is built here.
+
+Opt-in via ``HOROVOD_LOCK_DEBUG=1`` (zero footprint otherwise): calls to
+``threading.Lock``/``threading.RLock`` made from this package's modules
+(and from tests) return instrumented wrappers that
+
+- record per-thread acquisition stacks,
+- add a ``held-class -> acquired-class`` edge to a process-global
+  lock-order graph on every nested acquisition,
+- time every acquire and record *held-lock blocking waits* (an acquire
+  that blocked longer than ``HOROVOD_LOCK_DEBUG_SLOW_SECS`` while the
+  thread already held another lock — the convoy/starvation shape HVD001
+  catches statically for known-blocking calls),
+
+and an exit-time report names every **inversion cycle** (A→B in one
+thread, B→A in another: the classic deadlock-in-waiting) with the
+acquisition stacks that created the edges.
+
+Locks created by stdlib machinery (queue, logging, concurrent.futures)
+are deliberately NOT instrumented: the creation-site walk only
+instruments locks whose first non-threading stack frame belongs to this
+package or its tests, so hot stdlib paths keep raw C-speed locks.
+
+``tests/conftest.py`` installs the validator when the env knob is set, so
+the existing multiprocess + chaos suites double as the detector's
+workload: ``HOROVOD_LOCK_DEBUG=1 python -m pytest tests/`` turns every
+suite run into a race/deadlock hunt.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import env as env_mod
+
+__all__ = [
+    "install", "uninstall", "is_installed", "requested", "reset",
+    "snapshot", "restore", "slow_secs", "set_slow_secs",
+    "edges", "find_cycles", "slow_waits", "report", "check",
+]
+
+_MODULE_PREFIXES = ("horovod_tpu", "tests", "__main__", "__mp_main__")
+
+_installed = False
+_orig_lock = None
+_orig_rlock = None
+
+# All state guarded by _mu (a RAW lock, allocated before any patching).
+_mu = threading.Lock()
+#: (held_site, acquired_site) -> descriptor dict (thread, stacks) — first
+#: occurrence only; later identical edges just bump ``count``.
+_edges: Dict[Tuple[str, str], dict] = {}
+#: Held-lock blocking waits: acquire blocked > slow_secs while holding.
+_slow_waits: List[dict] = []
+#: Releases by a thread that never acquired (Lock-as-handoff-signal).
+_unmatched_releases: List[dict] = []
+_sites: Set[str] = set()
+_slow_secs = env_mod.DEFAULT_LOCK_DEBUG_SLOW_SECS
+
+_tls = threading.local()
+
+
+def requested() -> bool:
+    return env_mod.get_bool(env_mod.HOROVOD_LOCK_DEBUG)
+
+
+def _held_stack() -> list:
+    """This thread's stack of (instance_id, site, reentry_count) frames."""
+    try:
+        return _tls.held
+    except AttributeError:
+        _tls.held = []
+        return _tls.held
+
+
+def _creation_site() -> Optional[str]:
+    """Lock class = module:line of the first caller frame outside the
+    threading module and this file; None when that frame is not ours
+    (stdlib-internal locks stay raw)."""
+    f = sys._getframe(2)  # skip factory + this helper
+    while f is not None:
+        mod = f.f_globals.get("__name__", "")
+        if mod != "threading" and mod != __name__:
+            root = mod.split(".", 1)[0]
+            if root in _MODULE_PREFIXES:
+                return f"{mod}:{f.f_lineno}"
+            return None
+        f = f.f_back
+    return None
+
+
+def _short_stack(limit: int = 6) -> List[str]:
+    out = []
+    for fr in traceback.extract_stack(sys._getframe(3), limit=limit):
+        if fr.filename.endswith(("lockdep.py", "threading.py")):
+            continue
+        out.append(f"{os.path.basename(fr.filename)}:{fr.lineno}"
+                   f" in {fr.name}")
+    return out
+
+
+class _Instrumented:
+    """Wrapper over a real Lock/RLock.  Undeclared attributes delegate to
+    the real lock, which keeps ``threading.Condition`` working when handed
+    one of these (its ``_is_owned``/``_release_save``/``_acquire_restore``
+    fast paths hit the raw lock directly — the with-block enter/exit is
+    where the ordering information lives, and that stays instrumented)."""
+
+    __slots__ = ("_real", "_site", "_reentrant", "_owner_ident",
+                 "_foreign_credit")
+
+    def __init__(self, real, site: str, reentrant: bool):
+        self._real = real
+        self._site = site
+        self._reentrant = reentrant
+        #: ident of the thread whose held stack carries this lock's entry.
+        self._owner_ident = None
+        #: acquirer-ident -> pending foreign releases (guarded by _mu);
+        #: keyed per thread so only the stale entry's OWNER consumes a
+        #: credit — another thread's later matched release must not.
+        self._foreign_credit = None
+
+    # -- core protocol ---------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = time.monotonic()
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._record_acquire(time.monotonic() - t0)
+        return got
+
+    def release(self):
+        self._record_release()
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+    def __repr__(self):
+        return f"<lockdep {self._site} of {self._real!r}>"
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _record_acquire(self, waited: float) -> None:
+        held = _held_stack()
+        _prune_foreign(held)
+        me = id(self)
+        if self._reentrant:
+            for entry in held:
+                if entry[0] == me:
+                    entry[2] += 1
+                    return  # reentrant re-acquire: no new ordering info
+        new_edges = []
+        for _, held_site, _, _ in held:
+            if held_site != self._site:
+                new_edges.append((held_site, self._site))
+        slow = waited > _slow_secs and bool(held)
+        if new_edges or slow or self._site not in _sites:
+            stack = _short_stack()
+            with _mu:
+                _sites.add(self._site)
+                for key in new_edges:
+                    rec = _edges.get(key)
+                    if rec is None:
+                        _edges[key] = {
+                            "thread": threading.current_thread().name,
+                            "stack": stack,
+                            "count": 1,
+                        }
+                    else:
+                        rec["count"] += 1
+                if slow:
+                    _slow_waits.append({
+                        "site": self._site,
+                        "held": [s for _, s, _, _ in held],
+                        "thread": threading.current_thread().name,
+                        "waited_secs": round(waited, 3),
+                        "stack": stack,
+                    })
+        held.append([me, self._site, 1, self])
+        self._owner_ident = threading.get_ident()
+
+    def _record_release(self) -> None:
+        held = _held_stack()
+        _prune_foreign(held)
+        me = id(self)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == me:
+                held[i][2] -= 1
+                if held[i][2] <= 0:
+                    del held[i]
+                    self._owner_ident = None
+                return
+        # Releasing a lock this thread never (observably) acquired.  For
+        # RLocks that is Condition.wait's internal _acquire_restore path
+        # (ownership-enforced, not an error).  For plain Locks it is a
+        # cross-thread handoff release: credit the ACQUIRING thread so it
+        # prunes its now-stale held entry (which would otherwise fabricate
+        # ordering edges forever), and record it for the report.  The
+        # credit is keyed by the acquirer's ident — a later legitimate
+        # acquire/release by a third thread must not consume it.
+        owner = self._owner_ident
+        if not self._reentrant and owner is not None \
+                and owner != threading.get_ident():
+            with _mu:
+                if self._foreign_credit is None:
+                    self._foreign_credit = {}
+                self._foreign_credit[owner] = \
+                    self._foreign_credit.get(owner, 0) + 1
+                _unmatched_releases.append({
+                    "site": self._site,
+                    "thread": threading.current_thread().name,
+                })
+            self._owner_ident = None
+
+
+def _prune_foreign(held: list) -> None:
+    """Drop this thread's held entries whose lock was since released by a
+    DIFFERENT thread (Lock used as a handoff signal).  Runs before any
+    ordering bookkeeping, so a handed-off lock never contributes edges
+    past its foreign release."""
+    me = threading.get_ident()
+    for i in range(len(held) - 1, -1, -1):
+        inst = held[i][3]
+        credit = inst._foreign_credit
+        if credit:
+            with _mu:
+                n = credit.get(me, 0)
+                if n <= 0:
+                    continue
+                if n == 1:
+                    del credit[me]
+                else:
+                    credit[me] = n - 1
+            del held[i]
+
+
+def _make_factory(orig, reentrant: bool):
+    def factory():
+        real = orig()
+        site = _creation_site()
+        if site is None:
+            return real
+        return _Instrumented(real, site, reentrant)
+    return factory
+
+
+_atexit_registered = False
+
+
+def install(slow_secs: Optional[float] = None) -> None:
+    """Patch threading.Lock/RLock with instrumenting factories and register
+    the exit-time report.  Idempotent — but an explicit ``slow_secs`` is
+    adopted even when already installed (a test tightening the threshold
+    under an ambient HOROVOD_LOCK_DEBUG=1 session must not be ignored)."""
+    global _installed, _orig_lock, _orig_rlock, _slow_secs
+    global _atexit_registered
+    if slow_secs is not None:
+        _slow_secs = slow_secs
+    if _installed:
+        return
+    if slow_secs is None:
+        _slow_secs = env_mod.get_float(
+            env_mod.HOROVOD_LOCK_DEBUG_SLOW_SECS,
+            env_mod.DEFAULT_LOCK_DEBUG_SLOW_SECS)
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    threading.Lock = _make_factory(_orig_lock, reentrant=False)
+    threading.RLock = _make_factory(_orig_rlock, reentrant=True)
+    _installed = True
+    if not _atexit_registered:
+        atexit.register(_atexit_report)
+        _atexit_registered = True
+
+
+def slow_secs() -> float:
+    return _slow_secs
+
+
+def set_slow_secs(value: float) -> None:
+    global _slow_secs
+    _slow_secs = value
+
+
+def uninstall() -> None:
+    """Restore the raw factories.  Recorded state survives for
+    inspection; call ``reset()`` to clear it."""
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    _installed = False
+
+
+def is_installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    with _mu:
+        _edges.clear()
+        _slow_waits.clear()
+        _unmatched_releases.clear()
+        _sites.clear()
+
+
+def snapshot():
+    """Copy of the recorded state, for save/restore around tests that must
+    isolate their own assertions without discarding a surrounding
+    HOROVOD_LOCK_DEBUG=1 session's accumulated graph."""
+    with _mu:
+        return (dict(_edges), list(_slow_waits), set(_sites),
+                list(_unmatched_releases))
+
+
+def restore(snap) -> None:
+    with _mu:
+        _edges.clear()
+        _edges.update(snap[0])
+        _slow_waits[:] = snap[1]
+        _sites.clear()
+        _sites.update(snap[2])
+        _unmatched_releases[:] = snap[3] if len(snap) > 3 else []
+
+
+def edges() -> Dict[Tuple[str, str], dict]:
+    with _mu:
+        return dict(_edges)
+
+
+def slow_waits() -> List[dict]:
+    with _mu:
+        return list(_slow_waits)
+
+
+def find_cycles() -> List[List[str]]:
+    """Elementary cycles in the lock-order graph (Tarjan SCCs; every SCC
+    with more than one node — or a self-edge — is an inversion).  A
+    two-node cycle ``[A, B]`` is the classic A→B / B→A deadlock-in-
+    waiting."""
+    with _mu:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in _edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+
+    index_of: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    cycles: List[List[str]] = []
+
+    def strongconnect(root: str) -> None:
+        # Iterative Tarjan (recursion depth is unbounded by lock count).
+        work = [(root, iter(sorted(graph[root])))]
+        index_of[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index_of[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1 or v in graph.get(v, ()):
+                    cycles.append(sorted(scc))
+
+    for node in sorted(graph):
+        if node not in index_of:
+            strongconnect(node)
+    return cycles
+
+
+def report(file=None) -> bool:
+    """Write the human report; returns True when clean (no cycles)."""
+    file = file or sys.stderr
+    cycles = find_cycles()
+    waits = slow_waits()
+    with _mu:
+        n_sites, n_edges = len(_sites), len(_edges)
+    print(f"hvd-lockdep: {n_sites} lock class(es), {n_edges} order "
+          f"edge(s), {len(cycles)} inversion cycle(s), "
+          f"{len(waits)} held-lock blocking wait(s)", file=file)
+    for cyc in cycles:
+        print(f"hvd-lockdep: INVERSION CYCLE: {' -> '.join(cyc)} -> "
+              f"{cyc[0]}", file=file)
+        with _mu:
+            for (a, b), rec in sorted(_edges.items()):
+                if a in cyc and b in cyc:
+                    print(f"  edge {a} -> {b} (thread {rec['thread']}, "
+                          f"seen {rec['count']}x)", file=file)
+                    for line in rec["stack"]:
+                        print(f"    {line}", file=file)
+    for w in waits:
+        print(f"hvd-lockdep: SLOW ACQUIRE of {w['site']} "
+              f"({w['waited_secs']}s) while holding "
+              f"{', '.join(w['held'])} (thread {w['thread']})", file=file)
+        for line in w["stack"]:
+            print(f"    {line}", file=file)
+    with _mu:
+        unmatched = list(_unmatched_releases)
+    for u in unmatched:
+        print(f"hvd-lockdep: UNMATCHED RELEASE of {u['site']} by thread "
+              f"{u['thread']} (lock acquired by a different thread; "
+              "handoff-style usage carries no ordering)", file=file)
+    return not cycles
+
+
+def check() -> None:
+    """Raise if any inversion cycle has been recorded (test hook)."""
+    cycles = find_cycles()
+    if cycles:
+        raise RuntimeError(
+            "lock-order inversion cycle(s) detected: "
+            + "; ".join(" -> ".join(c) for c in cycles))
+
+
+def _atexit_report() -> None:
+    with _mu:
+        interesting = bool(_edges or _slow_waits or _unmatched_releases)
+    if _installed or interesting:
+        report()

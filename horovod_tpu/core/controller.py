@@ -165,10 +165,10 @@ class Controller:
         # recv/send at rank 0; the binomial tree spreads that over
         # O(log P) levels (every rank relays its subtree's bundles).
         # "auto" picks by world size at the measured crossover.
-        import os as _os
+        from ..common import env as env_mod
 
-        topo_env = _os.environ.get("HOROVOD_CONTROLLER_TOPOLOGY", "auto") \
-            .strip().lower()
+        topo_env = env_mod.get_str(
+            env_mod.HOROVOD_CONTROLLER_TOPOLOGY, "auto").strip().lower()
         if topo_env not in ("auto", "star", "tree"):
             raise ValueError(
                 f"HOROVOD_CONTROLLER_TOPOLOGY={topo_env!r}: expected "

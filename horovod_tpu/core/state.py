@@ -143,7 +143,7 @@ class HorovodGlobalState:
             xla_backend.context().reset()
         startup_timeout = env_mod.get_float(
             env_mod.HOROVOD_MESH_STARTUP_TIMEOUT, 60.0)
-        epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+        epoch = env_mod.get_epoch()
         store = None
         if topo.size == 1:
             self.mesh = None
@@ -604,7 +604,7 @@ class HorovodGlobalState:
                 from .thread_pool import ThreadPool
 
                 self._finalizer_pool = ThreadPool(
-                    env_mod.get_int("HOROVOD_NUM_FINALIZER_THREADS", 1),
+                    env_mod.get_int(env_mod.HOROVOD_NUM_FINALIZER_THREADS, 1),
                     name="horovod-finalizer")
             if status.eager_complete:
                 for e in entries:

@@ -70,11 +70,11 @@ class ElasticDriver:
         self.stopped_error: Optional[str] = None
         self.crash_failure_limit = crash_failure_limit if crash_failure_limit \
             is not None else env_mod.get_int(
-                "HOROVOD_ELASTIC_CRASH_FAILURE_LIMIT",
+                env_mod.HOROVOD_ELASTIC_CRASH_FAILURE_LIMIT,
                 DEFAULT_CRASH_FAILURE_LIMIT)
         self.transient_failure_limit = transient_failure_limit \
             if transient_failure_limit is not None else env_mod.get_int(
-                "HOROVOD_ELASTIC_TRANSIENT_FAILURE_LIMIT",
+                env_mod.HOROVOD_ELASTIC_TRANSIENT_FAILURE_LIMIT,
                 DEFAULT_TRANSIENT_FAILURE_LIMIT)
         self._crash_failures: Dict[str, int] = defaultdict(int)
         self._transient_failures: Dict[str, int] = defaultdict(int)

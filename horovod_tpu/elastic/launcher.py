@@ -82,7 +82,7 @@ def launch_elastic_job(args, command: List[str]) -> int:
     extra = config_parser.env_from_args(args)
     extra[env_mod.HOROVOD_ELASTIC] = "1"
     if args.reset_limit:
-        extra["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
+        extra[env_mod.HOROVOD_ELASTIC_RESET_LIMIT] = str(args.reset_limit)
 
     procs: Dict[str, subprocess.Popen] = {}
     pumps: List[_OutputPump] = []
@@ -98,16 +98,19 @@ def launch_elastic_job(args, command: List[str]) -> int:
         env = _slot_env(slot, rdv_addr if not _is_local(slot.hostname)
                         else "127.0.0.1", port, extra,
                         tpu_chip_binding=False)
-        env["HOROVOD_EPOCH"] = str(epoch)
+        env[env_mod.HOROVOD_EPOCH] = str(epoch)
         proc = spawn_worker(slot, command, env)
         identity = f"{slot.hostname}:{slot.local_rank}"
         with lock:
             procs[identity] = proc
         prefix = f"[{slot.rank}]<stdout>: " if args.verbose else ""
         eprefix = f"[{slot.rank}]<stderr>: " if args.verbose else ""
-        pumps.append(_OutputPump(proc.stdout, sys.stdout, prefix, None))
-        pumps.append(_OutputPump(proc.stderr, sys.stderr, eprefix, None))
+        pumps.append(_OutputPump(proc.stdout, sys.stdout, prefix, None,
+                                 name=f"hvd-pump-r{slot.rank}-out"))
+        pumps.append(_OutputPump(proc.stderr, sys.stderr, eprefix, None,
+                                 name=f"hvd-pump-r{slot.rank}-err"))
         threading.Thread(target=_monitor, args=(identity, slot, proc),
+                         name=f"hvd-elastic-mon-{identity}",
                          daemon=True).start()
 
     def _monitor(identity: str, slot: SlotInfo, proc: subprocess.Popen):

@@ -34,7 +34,7 @@ def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
     if not addr or not port:
         raise RuntimeError("elastic re-init requires a rendezvous server")
     store = HTTPStoreClient(addr, port)
-    my_epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+    my_epoch = env_mod.get_epoch()
 
     # Exponential backoff with jitter (capped ~2 s): after a host failure
     # EVERY surviving worker re-rendezvouses at once, and a fixed-period
@@ -79,7 +79,7 @@ def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
                      ("cross_rank", env_mod.HOROVOD_CROSS_RANK),
                      ("cross_size", env_mod.HOROVOD_CROSS_SIZE)]:
         os.environ[var] = str(slot[key])
-    os.environ["HOROVOD_EPOCH"] = str(slot["epoch"])
+    os.environ[env_mod.HOROVOD_EPOCH] = str(slot["epoch"])
     return ProcessTopology(
         rank=slot["rank"], size=slot["size"],
         local_rank=slot["local_rank"], local_size=slot["local_size"],

@@ -59,7 +59,7 @@ def _consume_host_update() -> Optional[bool]:
         _host_update_skip_sync[0] = True
         epoch = _host_update_epoch[0]
         _host_update_epoch[0] = -1.0
-    if epoch <= env_mod.get_int("HOROVOD_EPOCH", 0):
+    if epoch <= env_mod.get_epoch():
         return None  # stale: we already adopted this (or a newer) epoch
     return skip
 
@@ -274,7 +274,7 @@ def negotiate_jax_coordinator(topo) -> str:
         raise HorovodInternalError(
             "jax coordinator negotiation requires the rendezvous store")
     store = HTTPStoreClient(addr, port)
-    epoch = env_mod.get_int("HOROVOD_EPOCH", 0)
+    epoch = env_mod.get_epoch()
     scope = f"jaxcoord.{epoch}"
     if topo.rank == 0:
         import socket as _socket
@@ -397,7 +397,7 @@ class _NotificationManager:
         from .worker import start_notification_service
 
         start_notification_service()
-        limit = env_mod.get_int("HOROVOD_ELASTIC_RESET_LIMIT", 0)
+        limit = env_mod.get_int(env_mod.HOROVOD_ELASTIC_RESET_LIMIT, 0)
         self.reset_limit = limit if limit > 0 else None
 
 

@@ -34,7 +34,6 @@ poll, which of them are currently healthy).
 
 from __future__ import annotations
 
-import os
 import threading
 import urllib.error
 import urllib.request
@@ -42,6 +41,7 @@ from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+from ..common import env as env_mod
 from ..common.logging_util import get_logger
 from ..runner.hosts import HostInfo
 from .discovery import HostDiscovery
@@ -96,7 +96,7 @@ class TpuMetadataDiscovery(HostDiscovery):
                  max_pollers: int = 16):
         self._hosts = {h.hostname: h.slots for h in hosts}
         self._url = (url_template
-                     or os.environ.get("HOROVOD_TPU_METADATA_URL")
+                     or env_mod.get_str(env_mod.HOROVOD_TPU_METADATA_URL)
                      or DEFAULT_URL_TEMPLATE)
         if "{host}" not in self._url:
             raise ValueError(

@@ -73,8 +73,12 @@ def _drain_loop():
             if ops.poll(h):
                 try:
                     ops.synchronize(h)  # completed: instant, releases
-                except Exception:  # noqa: BLE001 — draining, result unused
-                    pass
+                except Exception as e:  # noqa: BLE001 — draining: the
+                    # result is unused, but the failure must not vanish
+                    # (HVD004): an abandoned window that FAILED (vs merely
+                    # straggled) points at an asymmetric rank error.
+                    log.debug("abandoned collective (handle %d) completed "
+                              "with error during drain: %s", h, e)
             elif time.monotonic() >= deadline:
                 log.warning(
                     "dropping abandoned in-flight collective (handle %d): "

@@ -112,7 +112,7 @@ def _scaled_dot_attention(q, k, v, causal: bool, dh: int):
     """Single-device attention for the "full" mode, [b, s, h, d] layout:
     XLA-fused einsum softmax by default, with the pallas flash-attention
     kernel available opt-in (see below for why it is not the default)."""
-    import os
+    from ..common import env as env_mod
 
     s = q.shape[1]
     # The pallas flash kernel is OPT-IN (HOROVOD_FLASH_ATTENTION=1): on
@@ -123,7 +123,7 @@ def _scaled_dot_attention(q, k, v, causal: bool, dh: int):
     # the MXU-scheduled einsum.  Sequence-parallel long-context paths
     # (ring/Ulysses in horovod_tpu.parallel) are where s² truly bites.
     if jax.default_backend() == "tpu" and \
-            os.environ.get("HOROVOD_FLASH_ATTENTION") == "1":
+            env_mod.get_str(env_mod.HOROVOD_FLASH_ATTENTION) == "1":
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention,

@@ -336,7 +336,7 @@ class ElasticRayExecutor:
                 env_mod.HOROVOD_CONTROLLER: "tcp",
                 env_mod.HOROVOD_SECRET_KEY: self._job_secret,
                 env_mod.HOROVOD_ELASTIC: "1",
-                "HOROVOD_EPOCH": str(epoch),
+                env_mod.HOROVOD_EPOCH: str(epoch),
             })
             env.update(self.settings.extra_env_vars)
             with self._lock:

@@ -209,8 +209,9 @@ class _OutputPump(threading.Thread):
     teeing into --output-filename/rank.N/ files (reference
     ``gloo_run.py:150-163``)."""
 
-    def __init__(self, stream, sink, prefix: str, tee_path: Optional[str]):
-        super().__init__(daemon=True)
+    def __init__(self, stream, sink, prefix: str, tee_path: Optional[str],
+                 name: str = "hvd-pump"):
+        super().__init__(daemon=True, name=name)
         self._stream = stream
         self._sink = sink
         self._prefix = prefix
@@ -315,8 +316,10 @@ def launch_job(args, command: List[str]) -> int:
                 out_t = err_t = None
             prefix = f"[{slot.rank}]<stdout>: " if args.verbose else ""
             eprefix = f"[{slot.rank}]<stderr>: " if args.verbose else ""
-            pumps.append(_OutputPump(proc.stdout, sys.stdout, prefix, out_t))
-            pumps.append(_OutputPump(proc.stderr, sys.stderr, eprefix, err_t))
+            pumps.append(_OutputPump(proc.stdout, sys.stdout, prefix, out_t,
+                                     name=f"hvd-pump-r{slot.rank}-out"))
+            pumps.append(_OutputPump(proc.stderr, sys.stderr, eprefix, err_t,
+                                     name=f"hvd-pump-r{slot.rank}-err"))
 
         # Poll ALL workers (not ordered wait): a crash in any rank must
         # tear the job down even while earlier ranks hang in collectives.

@@ -357,7 +357,7 @@ def run_elastic(fn: Callable, args: tuple = (),
             env_mod.HOROVOD_RENDEZVOUS_PORT: str(port),
             env_mod.HOROVOD_CONTROLLER: "tcp",
             env_mod.HOROVOD_ELASTIC: "1",
-            "HOROVOD_EPOCH": str(epoch),
+            env_mod.HOROVOD_EPOCH: str(epoch),
         })
         assigned[slot.hostname] = slot
         server.set(_ECMD_SCOPE, slot.hostname, json.dumps(env).encode())

@@ -27,9 +27,12 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 
+from ..common import env as env_mod
+
 #: Row cap for the store-less (driver-collect) fit path; 0 disables.
-INLINE_MAX_ROWS_ENV = "HOROVOD_SPARK_INLINE_MAX_ROWS"
-DEFAULT_INLINE_MAX_ROWS = 100_000
+#: Aliases of the env.py registry entries (the single config truth).
+INLINE_MAX_ROWS_ENV = env_mod.HOROVOD_SPARK_INLINE_MAX_ROWS
+DEFAULT_INLINE_MAX_ROWS = env_mod.DEFAULT_SPARK_INLINE_MAX_ROWS
 
 
 def guard_inline_collect(df) -> None:
@@ -48,7 +51,7 @@ def guard_inline_collect(df) -> None:
     from ..common.logging_util import get_logger
 
     log = get_logger("horovod_tpu.spark")
-    cap = int(os.environ.get(INLINE_MAX_ROWS_ENV, DEFAULT_INLINE_MAX_ROWS))
+    cap = env_mod.get_int(INLINE_MAX_ROWS_ENV, DEFAULT_INLINE_MAX_ROWS)
     log.warning(
         "no store= configured: fit() will collect the full DataFrame "
         "onto the driver. Pass store= (LocalStore/...) to keep the "

@@ -1,0 +1,6 @@
+"""Developer tooling that ships with the package (hvd-lint lives here).
+
+Nothing under ``tools`` is imported by the runtime — keeping the checkers
+inside the package (instead of a detached scripts/ dir) means the lint
+rules version together with the invariants they enforce.
+"""

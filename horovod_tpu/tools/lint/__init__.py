@@ -1,0 +1,318 @@
+"""hvd-lint — codebase-invariant static analysis for horovod_tpu.
+
+The failure plane (PR 2) closed a class of distributed hangs, but its
+invariants were enforced only by convention: one new ``recv()`` under a
+held ``send_lock``, one typo'd ``faults.inject("tcp.rcv")`` site, or one
+silently-swallowed background-thread exception quietly reopens the hang
+class.  Horovod proper leans on C++ sanitizers/TSan for this; our control
+plane is pure Python, so the equivalent is built in-repo: a small AST
+checker framework with rules tuned to THIS codebase's contracts.
+
+Usage::
+
+    python -m horovod_tpu.tools.lint horovod_tpu/
+    hvd-lint horovod_tpu/ tests/some_file.py
+
+Rules (see ``rules.py`` and ``docs/static_analysis.md``):
+
+==========  ===========================================================
+HVD000      malformed/unjustified ``# hvdlint: disable=...`` comment
+HVD001      blocking call while holding a lock
+HVD002      raw ``HOROVOD_*`` env literal outside ``common/env.py``
+HVD003      fault site not in ``faults.SITES`` / undocumented site
+HVD004      swallowed exception in a thread-target/daemon-loop body
+HVD005      control-frame wire-tag invariants in ``core/messages.py``
+HVD006      anonymous thread (``threading.Thread`` without ``name=``)
+==========  ===========================================================
+
+Suppressions: a violation is silenced by a comment on its line (or on a
+comment-only line directly above it)::
+
+    sock.sendall(buf)  # hvdlint: disable=HVD001 -- bounded by settimeout(5)
+
+The justification after ``--`` is REQUIRED — a suppression that doesn't
+say *why* the invariant is safe to break here is itself a violation
+(HVD000).  Unknown rule codes in a suppression are HVD000 too, so a typo
+can't silently disable nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Violation", "FileContext", "Project", "lint_paths", "lint_source",
+    "format_violation", "main",
+]
+
+
+@dataclass(frozen=True)
+class Violation:
+    code: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+
+# Suppression-comment grammar (one or more codes, then a mandatory
+# justification; see the module docstring for the written form — spelling
+# the literal syntax here would make this very comment parse as one).
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*disable=\s*([A-Za-z0-9_,\s]+?)\s*"
+    r"(?:--\s*(?P<why>.*?))?\s*$")
+
+
+@dataclass
+class _Suppression:
+    codes: Tuple[str, ...]
+    justification: str
+    comment_line: int
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs about one source file."""
+
+    path: str            # path as given on the command line
+    rel_path: str        # posix-style path relative to the package root
+    source: str
+    tree: ast.AST
+    suppressions: Dict[int, List[_Suppression]] = field(default_factory=dict)
+    pre_errors: List[Violation] = field(default_factory=list)
+
+
+class Project:
+    """Cross-file state shared by all rules in one lint run (the fault-site
+    registry, the fault-injection doc) — resolved lazily so linting an
+    arbitrary file list doesn't require the whole tree."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or _find_package_root()
+        self._sites: Optional[Tuple[str, ...]] = None
+        self._fault_doc: Optional[str] = None
+
+    @property
+    def fault_sites(self) -> Tuple[str, ...]:
+        """``faults.SITES`` parsed from the AST of common/faults.py —
+        parsed, not imported, so linting never executes package code (an
+        import would run ``configure()`` against the ambient env)."""
+        if self._sites is None:
+            self._sites = self._parse_sites()
+        return self._sites
+
+    def _parse_sites(self) -> Tuple[str, ...]:
+        path = os.path.join(self.root, "horovod_tpu", "common", "faults.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return ()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "SITES":
+                        vals = getattr(node.value, "elts", [])
+                        return tuple(
+                            v.value for v in vals
+                            if isinstance(v, ast.Constant)
+                            and isinstance(v.value, str))
+        return ()
+
+    @property
+    def fault_doc(self) -> str:
+        if self._fault_doc is None:
+            path = os.path.join(self.root, "docs", "fault_injection.md")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._fault_doc = f.read()
+            except OSError:
+                self._fault_doc = ""
+        return self._fault_doc
+
+
+def _find_package_root() -> str:
+    """Repo root = the directory holding the ``horovod_tpu`` package."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def _collect_suppressions(source: str, path: str):
+    """Map line -> suppressions; malformed comments become HVD000."""
+    sup: Dict[int, List[_Suppression]] = {}
+    errors: List[Violation] = []
+    from .rules import RULE_CODES  # late: rules imports this module
+
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return sup, errors
+    # Comment-only lines: a suppression there applies to the next
+    # non-blank source line (the statement it precedes).
+    code_lines = set()
+    comment_tokens = []
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            comment_tokens.append(tok)
+        elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                              tokenize.INDENT, tokenize.DEDENT,
+                              tokenize.ENCODING, tokenize.ENDMARKER):
+            code_lines.add(tok.start[0])
+    src_lines = source.splitlines()
+    for tok in comment_tokens:
+        text = tok.string
+        if "hvdlint" not in text:
+            continue
+        m = _SUPPRESS_RE.search(text)
+        line = tok.start[0]
+        if m is None:
+            errors.append(Violation(
+                "HVD000", path, line, tok.start[1],
+                "malformed hvdlint comment; expected "
+                "'# hvdlint: disable=HVD00x -- justification'"))
+            continue
+        codes = tuple(c.strip().upper() for c in m.group(1).split(",")
+                      if c.strip())
+        why = (m.group("why") or "").strip()
+        bad = [c for c in codes if c not in RULE_CODES]
+        if bad:
+            errors.append(Violation(
+                "HVD000", path, line, tok.start[1],
+                f"suppression names unknown rule(s) {', '.join(bad)}"))
+            continue
+        if not why:
+            errors.append(Violation(
+                "HVD000", path, line, tok.start[1],
+                f"suppression of {', '.join(codes)} lacks a justification "
+                "('-- <why this is safe here>' is required)"))
+            continue
+        target = line
+        if line not in code_lines:
+            # Comment-only line: applies to the next code line.
+            nxt = line + 1
+            while nxt <= len(src_lines) and nxt not in code_lines:
+                nxt += 1
+            target = nxt
+        sup.setdefault(target, []).append(
+            _Suppression(codes, why, line))
+    return sup, errors
+
+
+def _lint_file_context(ctx: FileContext, project: Project) -> List[Violation]:
+    from .rules import ALL_RULES
+
+    raw: List[Violation] = list(ctx.pre_errors)
+    for rule in ALL_RULES:
+        raw.extend(rule.check(ctx, project))
+    out = []
+    for v in raw:
+        if v.code != "HVD000":
+            sups = ctx.suppressions.get(v.line, [])
+            if any(v.code in s.codes for s in sups):
+                continue
+        out.append(v)
+    return out
+
+
+def _make_context(path: str, source: str, root: str) -> FileContext:
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        ctx = FileContext(path=path, rel_path=rel, source=source,
+                          tree=ast.Module(body=[], type_ignores=[]))
+        ctx.pre_errors.append(Violation(
+            "HVD000", path, e.lineno or 1, e.offset or 0,
+            f"file does not parse: {e.msg}"))
+        return ctx
+    sup, errors = _collect_suppressions(source, path)
+    return FileContext(path=path, rel_path=rel, source=source, tree=tree,
+                       suppressions=sup, pre_errors=errors)
+
+
+def lint_source(source: str, path: str = "<string>",
+                project: Optional[Project] = None) -> List[Violation]:
+    """Lint one in-memory source blob (the test-fixture entry point)."""
+    project = project or Project()
+    ctx = _make_context(path, source, project.root)
+    return _lint_file_context(ctx, project)
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        else:
+            yield p
+
+
+def lint_paths(paths: Sequence[str],
+               project: Optional[Project] = None) -> List[Violation]:
+    project = project or Project()
+    violations: List[Violation] = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            violations.append(Violation("HVD000", path, 1, 0,
+                                        f"cannot read file: {e}"))
+            continue
+        ctx = _make_context(path, source, project.root)
+        violations.extend(_lint_file_context(ctx, project))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.code))
+    return violations
+
+
+def format_violation(v: Violation) -> str:
+    return f"{v.path}:{v.line}:{v.col}: {v.code} {v.message}"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    from .rules import ALL_RULES
+
+    ap = argparse.ArgumentParser(
+        prog="hvd-lint",
+        description="codebase-invariant static analysis for horovod_tpu")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint "
+                         "(default: the horovod_tpu package)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--root", default=None,
+                    help="repo root override (registry/doc lookups)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.code}  {rule.title}")
+        return 0
+
+    project = Project(root=args.root)
+    paths = args.paths or [os.path.join(project.root, "horovod_tpu")]
+    files = list(_iter_py_files(paths))
+    violations = lint_paths(files, project)
+    for v in violations:
+        print(format_violation(v))
+    n_files = len(files)
+    if violations:
+        print(f"hvd-lint: {len(violations)} violation(s) in "
+              f"{n_files} file(s)", file=sys.stderr)
+        return 1
+    print(f"hvd-lint: {n_files} file(s) clean", file=sys.stderr)
+    return 0

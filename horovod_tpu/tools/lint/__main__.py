@@ -1,0 +1,8 @@
+"""``python -m horovod_tpu.tools.lint`` entry point."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

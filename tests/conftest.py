@@ -31,6 +31,29 @@ if "jax" in sys.modules:
 
     jax.config.update("jax_platforms", "cpu")
 
+# Lockdep (horovod_tpu/common/lockdep.py): when HOROVOD_LOCK_DEBUG is
+# enabled, instrument THIS pytest process too (worker subprocesses
+# self-install via the horovod_tpu import hook), so every in-process
+# suite feeds the lock-order graph.  The exit-time report prints cycles;
+# pytest_terminal_summary below surfaces the verdict per run.
+
+
+def _lock_debug_enabled() -> bool:
+    # Same truthiness as env.get_bool, without importing the package for
+    # the (common) disabled case: "0"/"false"/"no"/"off"/"" are OFF.
+    val = os.environ.get("HOROVOD_LOCK_DEBUG", "")
+    return val.lower() not in ("", "0", "false", "no", "off")
+
+
+if _lock_debug_enabled():
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from horovod_tpu.common import lockdep as _lockdep
+
+    _lockdep.install()
+
 
 def pytest_configure(config):
     # Audit trail for the infra-retry gate (helpers._log_retry): a de-flake
@@ -101,6 +124,17 @@ def pytest_runtest_call(item):
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if _lock_debug_enabled():
+        from horovod_tpu.common import lockdep
+
+        cycles = lockdep.find_cycles()
+        terminalreporter.write_line(
+            f"lockdep: {len(lockdep.edges())} lock-order edge(s), "
+            f"{len(cycles)} inversion cycle(s), "
+            f"{len(lockdep.slow_waits())} held-lock blocking wait(s)")
+        for cyc in cycles:
+            terminalreporter.write_line(
+                "lockdep INVERSION CYCLE: " + " -> ".join(cyc + cyc[:1]))
     path = os.environ.get("HVD_TEST_RETRY_LOG")
     lines = []
     if path and os.path.exists(path):

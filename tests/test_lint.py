@@ -1,0 +1,574 @@
+"""hvd-lint: per-rule fixtures + the zero-violation contract on the tree.
+
+Every rule gets three fixtures — one violating, one clean, one suppressed
+with a justification — so a rule that silently stops firing (or starts
+over-firing) fails here, not in review.  The capstone test runs the full
+pass over ``horovod_tpu/`` and asserts zero violations: landing a change
+that breaks an invariant makes THIS file fail with the right rule code.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from horovod_tpu.tools.lint import (  # noqa: E402
+    Project,
+    lint_paths,
+    lint_source,
+    main,
+)
+from horovod_tpu.tools.lint.rules import RULE_CODES  # noqa: E402
+
+PKG = os.path.join(REPO_ROOT, "horovod_tpu")
+PROJECT = Project(root=REPO_ROOT)
+
+
+def run(src: str, path: str = "<fixture>"):
+    return lint_source(textwrap.dedent(src), path=path, project=PROJECT)
+
+
+def codes(violations):
+    return sorted({v.code for v in violations})
+
+
+@pytest.fixture(scope="module")
+def tree_violations():
+    """One full-tree pass shared by every test that needs it."""
+    return lint_paths([PKG], PROJECT)
+
+
+# ---------------------------------------------------------------------------
+# HVD001 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+HVD001_WITH = """
+    import threading, time
+    lock = threading.Lock()
+    def f():
+        with lock:
+            time.sleep(1)
+"""
+
+HVD001_ACQUIRE = """
+    import time
+    class C:
+        def f(self):
+            self._lock.acquire()
+            try:
+                data = self.sock.recv(4)
+            finally:
+                self._lock.release()
+"""
+
+HVD001_CLEAN = """
+    import threading, time
+    lock = threading.Lock()
+    def f():
+        with lock:
+            x = 1
+        time.sleep(1)
+        done.wait(timeout=5)
+"""
+
+HVD001_SUPPRESSED = """
+    import threading, time
+    lock = threading.Lock()
+    def f():
+        with lock:
+            time.sleep(1)  # hvdlint: disable=HVD001 -- fixture: bounded by test harness
+"""
+
+
+def test_hvd001_with_block():
+    vs = run(HVD001_WITH)
+    assert codes(vs) == ["HVD001"]
+    assert "time.sleep" in vs[0].message
+
+
+def test_hvd001_acquire_release_region():
+    vs = run(HVD001_ACQUIRE)
+    assert codes(vs) == ["HVD001"]
+    assert "socket" in vs[0].message
+
+
+def test_hvd001_clean():
+    assert run(HVD001_CLEAN) == []
+
+
+def test_hvd001_suppressed():
+    assert run(HVD001_SUPPRESSED) == []
+
+
+def test_hvd001_string_join_not_flagged():
+    # str.join takes a positional iterable; thread joins take none.
+    src = """
+        import threading
+        lock = threading.Lock()
+        def f(parts, t):
+            with lock:
+                s = ",".join(parts)
+            t.join()
+    """
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD002 — raw HOROVOD_* env literal outside common/env.py
+# ---------------------------------------------------------------------------
+
+HVD002_VIOLATING = """
+    import os
+    a = os.environ.get("HOROVOD_FOO")
+    b = os.getenv("HOROVOD_BAR", "1")
+    os.environ["HOROVOD_BAZ"] = "x"
+    c = env_mod.get_int("HOROVOD_QUX", 0)
+"""
+
+HVD002_CLEAN = """
+    import os
+    from horovod_tpu.common import env as env_mod
+    a = env_mod.get_str(env_mod.HOROVOD_ELASTIC)
+    b = os.environ.get(env_mod.HOROVOD_RANK)
+    c = os.environ.get("NOT_A_KNOB")
+"""
+
+HVD002_SUPPRESSED = """
+    import os
+    a = os.environ.get("HOROVOD_FOO")  # hvdlint: disable=HVD002 -- fixture: pretend legacy shim
+"""
+
+
+def test_hvd002_violating():
+    vs = run(HVD002_VIOLATING)
+    assert codes(vs) == ["HVD002"]
+    assert len(vs) == 4
+    assert {"HOROVOD_FOO", "HOROVOD_BAR", "HOROVOD_BAZ", "HOROVOD_QUX"} == {
+        v.message.split("'")[1] for v in vs}
+
+
+def test_hvd002_clean():
+    assert run(HVD002_CLEAN) == []
+
+
+def test_hvd002_env_py_itself_exempt():
+    path = os.path.join(PKG, "common", "env.py")
+    assert run(HVD002_VIOLATING, path=path) == []
+
+
+def test_hvd002_suppressed():
+    assert run(HVD002_SUPPRESSED) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD003 — fault sites
+# ---------------------------------------------------------------------------
+
+HVD003_VIOLATING = """
+    from horovod_tpu.common import faults
+    def f():
+        if faults.ACTIVE:
+            faults.inject("tcp.rcv")
+"""
+
+HVD003_CLEAN = """
+    from horovod_tpu.common import faults
+    def f():
+        if faults.ACTIVE:
+            faults.inject("tcp.recv", rank=0, peer=1)
+"""
+
+HVD003_SUPPRESSED = """
+    from horovod_tpu.common import faults
+    def f():
+        faults.inject("tcp.rcv")  # hvdlint: disable=HVD003 -- fixture: deliberately bogus site
+"""
+
+
+def test_hvd003_registry_is_populated():
+    # The rule is only as good as the registry parse; guard it.
+    assert "tcp.recv" in PROJECT.fault_sites
+    assert len(PROJECT.fault_sites) >= 6
+
+
+def test_hvd003_unknown_site():
+    vs = run(HVD003_VIOLATING)
+    assert codes(vs) == ["HVD003"]
+    assert "tcp.rcv" in vs[0].message
+
+
+def test_hvd003_known_site():
+    assert run(HVD003_CLEAN) == []
+
+
+def test_hvd003_suppressed():
+    assert run(HVD003_SUPPRESSED) == []
+
+
+def test_hvd003_every_site_documented():
+    doc_path = os.path.join(REPO_ROOT, "docs", "fault_injection.md")
+    with open(doc_path, encoding="utf-8") as f:
+        doc = f.read()
+    for site in PROJECT.fault_sites:
+        assert f"`{site}`" in doc, (
+            f"fault site {site!r} missing from docs/fault_injection.md")
+
+
+# ---------------------------------------------------------------------------
+# HVD004 — swallowed exception in thread bodies
+# ---------------------------------------------------------------------------
+
+HVD004_VIOLATING = """
+    import threading
+    def _worker_loop():
+        while True:
+            try:
+                step()
+            except Exception:
+                pass
+    threading.Thread(target=_worker_loop, name="w", daemon=True).start()
+"""
+
+HVD004_CLEAN = """
+    import threading
+    def _worker_loop():
+        while True:
+            try:
+                step()
+            except Exception as e:
+                log.error("worker died: %s", e)
+    def _other_loop():
+        try:
+            step()
+        except ValueError:
+            pass  # narrow type: fine
+    def not_a_thread_body():
+        try:
+            step()
+        except Exception:
+            pass  # broad, but not a thread body: HVD004 does not apply
+"""
+
+HVD004_SUPPRESSED = """
+    def _worker_loop():
+        try:
+            step()
+        except Exception:  # hvdlint: disable=HVD004 -- fixture: probe loop, errors expected
+            pass
+"""
+
+
+def test_hvd004_violating():
+    vs = run(HVD004_VIOLATING)
+    assert codes(vs) == ["HVD004"]
+    assert "_worker_loop" in vs[0].message
+
+
+def test_hvd004_clean():
+    assert run(HVD004_CLEAN) == []
+
+
+def test_hvd004_base_exception():
+    # BaseException is broader than Exception — the one-word change that
+    # would reopen the silent-loop-death class must not lint clean.
+    src = """
+        import threading
+        def _worker_loop():
+            try:
+                step()
+            except BaseException:
+                pass
+        threading.Thread(target=_worker_loop, name="w").start()
+    """
+    assert codes(run(src)) == ["HVD004"]
+
+
+def test_hvd004_suppressed():
+    assert run(HVD004_SUPPRESSED) == []
+
+
+def test_hvd004_thread_subclass_run():
+    src = """
+        import threading
+        class Pump(threading.Thread):
+            def __init__(self):
+                super().__init__(name="pump")
+            def run(self):
+                try:
+                    go()
+                except Exception:
+                    pass
+    """
+    assert codes(run(src)) == ["HVD004"]
+
+
+def test_hvd004_stash_and_surface_is_loud():
+    # Capturing the exception object for the parent to surface (error
+    # list, attribute) is propagation, not a silent swallow.
+    src = """
+        import threading
+        errs = []
+        def _worker_loop():
+            try:
+                step()
+            except BaseException as e:
+                errs.append(e)
+        threading.Thread(target=_worker_loop, name="w").start()
+    """
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD005 — wire-tag invariants (scoped to core/messages.py)
+# ---------------------------------------------------------------------------
+
+MESSAGES_PATH = os.path.join(PKG, "core", "messages.py")
+
+HVD005_DUPLICATE = """
+    A_MAGIC = 0x11111111
+    B_MAGIC = 0x11111111
+    class F:
+        def to_bytes(self):
+            w = Writer()
+            w.u32(A_MAGIC)
+            return w.getvalue()
+    class G:
+        def to_bytes(self):
+            w = Writer()
+            w.u32(B_MAGIC)
+            return w.getvalue()
+"""
+
+HVD005_MISSING_MAGIC = """
+    A_MAGIC = 0x11111111
+    class F:
+        def to_bytes(self):
+            w = Writer()
+            w.u8(1)
+            return w.getvalue()
+"""
+
+HVD005_MAGIC_NOT_FIRST = """
+    A_MAGIC = 0x11111111
+    class F:
+        def to_bytes(self):
+            w = Writer()
+            w.u8(2)
+            w.u32(A_MAGIC)
+            return w.getvalue()
+"""
+
+HVD005_CTRL_BIT = """
+    A_MAGIC = 0x11111111
+    FLAG = 1 << 63
+    class F:
+        def to_bytes(self):
+            w = Writer()
+            w.u32(A_MAGIC)
+            return w.getvalue()
+"""
+
+HVD005_CLEAN = """
+    A_MAGIC = 0x11111111
+    B_MAGIC = 0x22222222
+    class F:
+        def to_bytes(self):
+            w = Writer()
+            w.u32(A_MAGIC)
+            return w.getvalue()
+"""
+
+
+def test_hvd005_duplicate_magic():
+    vs = run(HVD005_DUPLICATE, path=MESSAGES_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "duplicates" in vs[0].message
+
+
+def test_hvd005_missing_magic():
+    vs = run(HVD005_MISSING_MAGIC, path=MESSAGES_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "to_bytes" in vs[0].message
+
+
+def test_hvd005_magic_not_first_write():
+    # A u8 written before the u32 magic shifts the leading bytes off the
+    # tag even though a magic u32 exists somewhere in to_bytes.
+    vs = run(HVD005_MAGIC_NOT_FIRST, path=MESSAGES_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "first field" in vs[0].message
+
+
+def test_hvd005_ctrl_bit():
+    vs = run(HVD005_CTRL_BIT, path=MESSAGES_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "control-frame" in vs[0].message
+
+
+def test_hvd005_clean_and_scoped():
+    assert run(HVD005_CLEAN, path=MESSAGES_PATH) == []
+    # The same duplicate-magic source outside core/messages.py is not
+    # this rule's business.
+    assert run(HVD005_DUPLICATE) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD006 — anonymous threads
+# ---------------------------------------------------------------------------
+
+HVD006_VIOLATING = """
+    import threading
+    threading.Thread(target=print, daemon=True).start()
+"""
+
+HVD006_CLEAN = """
+    import threading
+    threading.Thread(target=print, name="printer", daemon=True).start()
+"""
+
+HVD006_SUPPRESSED = """
+    import threading
+    threading.Thread(target=print, daemon=True).start()  # hvdlint: disable=HVD006 -- fixture: throwaway
+"""
+
+HVD006_SUBCLASS_VIOLATING = """
+    import threading
+    class Pump(threading.Thread):
+        def __init__(self, stream):
+            super().__init__(daemon=True)
+            self._stream = stream
+"""
+
+HVD006_SUBCLASS_CLEAN = """
+    import threading
+    class Pump(threading.Thread):
+        def __init__(self, stream, name):
+            super().__init__(daemon=True, name=name)
+            self._stream = stream
+    class Pump2(threading.Thread):
+        def __init__(self):
+            super().__init__(daemon=True)
+            self.name = "pump2"
+"""
+
+
+def test_hvd006_violating():
+    assert codes(run(HVD006_VIOLATING)) == ["HVD006"]
+
+
+def test_hvd006_clean():
+    assert run(HVD006_CLEAN) == []
+
+
+def test_hvd006_suppressed():
+    assert run(HVD006_SUPPRESSED) == []
+
+
+def test_hvd006_thread_subclass():
+    # Subclass instantiation has no target= kw, so the Thread(...) check
+    # never fires — the subclass __init__ itself must name the thread.
+    vs = run(HVD006_SUBCLASS_VIOLATING)
+    assert codes(vs) == ["HVD006"]
+    assert "Pump" in vs[0].message
+    assert run(HVD006_SUBCLASS_CLEAN) == []
+
+
+def test_hvd006_executor_needs_name_prefix():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+        pool = ThreadPoolExecutor(max_workers=2)
+    """
+    assert codes(run(src)) == ["HVD006"]
+
+
+# ---------------------------------------------------------------------------
+# HVD000 — suppression hygiene
+# ---------------------------------------------------------------------------
+
+def test_suppression_requires_justification():
+    src = """
+        import threading, time
+        lock = threading.Lock()
+        def f():
+            with lock:
+                time.sleep(1)  # hvdlint: disable=HVD001
+    """
+    vs = run(src)
+    # The unjustified suppression is itself a violation AND does not
+    # silence the original finding.
+    assert codes(vs) == ["HVD000", "HVD001"]
+    assert "justification" in next(
+        v.message for v in vs if v.code == "HVD000")
+
+
+def test_suppression_unknown_code_is_error():
+    src = 'x = 1  # hvdlint: disable=HVD999 -- bogus\n'
+    vs = run(src)
+    assert codes(vs) == ["HVD000"]
+    assert "HVD999" in vs[0].message
+
+
+def test_suppression_on_preceding_comment_line():
+    src = """
+        import threading, time
+        lock = threading.Lock()
+        def f():
+            with lock:
+                # hvdlint: disable=HVD001 -- fixture: applies to next line
+                time.sleep(1)
+    """
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# the tree-wide contract
+# ---------------------------------------------------------------------------
+
+def test_tree_is_clean(tree_violations):
+    assert tree_violations == [], "\n".join(
+        f"{v.path}:{v.line}: {v.code} {v.message}" for v in tree_violations)
+
+
+def test_no_anonymous_threads_in_tree(tree_violations):
+    # Satellite contract: lockdep and the stall inspector must be able to
+    # attribute every background thread by name.
+    assert [v for v in tree_violations if v.code == "HVD006"] == []
+
+
+@pytest.mark.parametrize("code,fixture", [
+    ("HVD001", HVD001_WITH),
+    ("HVD002", HVD002_VIOLATING),
+    ("HVD003", HVD003_VIOLATING),
+    ("HVD004", HVD004_VIOLATING),
+    ("HVD006", HVD006_VIOLATING),
+])
+def test_seeded_violation_fails_with_right_code(tmp_path, code, fixture):
+    """Seeding any single violation into a linted tree must fail the pass
+    with exactly that rule code (the acceptance-criteria probe)."""
+    seeded = tmp_path / "seeded.py"
+    seeded.write_text(textwrap.dedent(fixture))
+    vs = lint_paths([str(tmp_path)], PROJECT)
+    assert codes(vs) == [code]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(HVD006_VIOLATING))
+    assert main([str(tmp_path), "--root", REPO_ROOT]) == 1
+    out = capsys.readouterr().out
+    assert "HVD006" in out
+    good = tmp_path / "sub"
+    good.mkdir()
+    (good / "ok.py").write_text("x = 1\n")
+    assert main([str(good), "--root", REPO_ROOT]) == 0
+
+
+def test_rule_codes_catalog():
+    assert RULE_CODES == {"HVD000", "HVD001", "HVD002", "HVD003",
+                          "HVD004", "HVD005", "HVD006"}

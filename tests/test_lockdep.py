@@ -1,0 +1,273 @@
+"""lockdep: the runtime lock-order validator must be demonstrably live.
+
+The headline test constructs a real two-thread A->B / B->A inversion and
+asserts the detector reports exactly that cycle — proving that a chaos or
+multiprocess run under ``HOROVOD_LOCK_DEBUG=1`` reporting zero cycles
+means *validated*, not *not measured*.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from horovod_tpu.common import lockdep  # noqa: E402
+
+
+@pytest.fixture()
+def lockdep_session():
+    """Install around the test with a tight slow-wait threshold.
+
+    When the suite already runs under HOROVOD_LOCK_DEBUG=1 (conftest
+    installed lockdep session-wide), the validator must stay installed and
+    the session's accumulated graph must survive this file: snapshot the
+    state, run the test against a clean slate, then put everything back.
+    """
+    was_installed = lockdep.is_installed()
+    prev_slow = lockdep.slow_secs()
+    snap = lockdep.snapshot()
+    lockdep.reset()
+    lockdep.install(slow_secs=0.15)
+    try:
+        yield lockdep
+    finally:
+        if not was_installed:
+            lockdep.uninstall()
+        lockdep.set_slow_secs(prev_slow)
+        lockdep.restore(snap)
+
+
+def _run_thread(fn, name):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_locks_are_instrumented(lockdep_session):
+    lk = threading.Lock()
+    assert isinstance(lk, lockdep._Instrumented)
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_inversion_cycle_reported(lockdep_session):
+    """A->B in one thread, B->A in another: no deadlock occurs (the
+    threads run sequentially), but the ORDER disagreement alone must be
+    reported — that is the whole lockdep idea."""
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    _run_thread(order_ab, "lockdep-ab")
+    _run_thread(order_ba, "lockdep-ba")
+
+    cycles = lockdep.find_cycles()
+    assert len(cycles) == 1
+    assert len(cycles[0]) == 2
+    # Both sites live in this module.
+    assert all(site.startswith("tests.test_lockdep:") for site in cycles[0])
+    with pytest.raises(RuntimeError, match="inversion"):
+        lockdep.check()
+    assert lockdep.report(file=open(os.devnull, "w")) is False
+
+
+def test_consistent_order_is_clean(lockdep_session):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def nested():
+        with a:
+            with b:
+                pass
+
+    _run_thread(nested, "lockdep-c1")
+    _run_thread(nested, "lockdep-c2")
+    assert lockdep.find_cycles() == []
+    assert lockdep.edges()  # the A->B edge itself was recorded
+    lockdep.check()  # must not raise
+    assert lockdep.report(file=open(os.devnull, "w")) is True
+
+
+def test_held_lock_blocking_wait_recorded(lockdep_session):
+    a = threading.Lock()
+    c = threading.Lock()
+    entered = threading.Event()
+
+    def holder():
+        with a:
+            entered.set()
+            time.sleep(0.4)
+
+    t = threading.Thread(target=holder, name="lockdep-holder")
+    t.start()
+    assert entered.wait(timeout=5)
+    with c:
+        with a:  # blocks ~0.4s while holding c
+            pass
+    t.join(timeout=5)
+
+    waits = lockdep.slow_waits()
+    assert waits, "expected a held-lock blocking wait to be recorded"
+    assert any(w["waited_secs"] >= 0.15 and w["held"] for w in waits)
+
+
+def test_rlock_reentrancy_no_self_cycle(lockdep_session):
+    r = threading.RLock()
+
+    def reenter():
+        with r:
+            with r:
+                pass
+
+    _run_thread(reenter, "lockdep-reenter")
+    assert lockdep.find_cycles() == []
+
+
+def test_condition_on_instrumented_lock(lockdep_session):
+    cv = threading.Condition()
+    with cv:
+        cv.wait(timeout=0.01)
+    with cv:
+        cv.notify_all()
+    assert lockdep.find_cycles() == []
+
+
+def test_stdlib_locks_stay_raw(lockdep_session):
+    # queue.Queue allocates its mutex inside queue.py — must NOT be
+    # instrumented (hot stdlib paths keep C-speed locks).
+    q = queue.Queue()
+    assert not isinstance(q.mutex, lockdep._Instrumented)
+
+
+def test_handoff_release_prunes_stale_entry(lockdep_session):
+    """A Lock acquired by one thread and released by another (handoff
+    signal) must not leave a stale held entry fabricating ordering edges
+    on the acquiring thread — and the unmatched release is reported."""
+    handoff = threading.Lock()
+    a = threading.Lock()
+    b = threading.Lock()
+
+    # No Event signalling here: an instrumented lock op while handoff is
+    # held would record a REAL (and test-irrelevant) ordering edge, so
+    # both sides poll the raw lock state instead.
+    def releaser():
+        deadline = time.time() + 5
+        while not handoff.locked() and time.time() < deadline:
+            time.sleep(0.01)
+        handoff.release()  # ... another thread releases
+
+    t = threading.Thread(target=releaser, name="lockdep-releaser")
+    t.start()
+    handoff.acquire()  # ... the main thread acquired
+    deadline = time.time() + 5
+    while handoff.locked() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not handoff.locked(), "foreign release never happened"
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+    # Post-handoff, main takes a then b; without pruning, the stale
+    # handoff entry would fabricate handoff->a and handoff->b edges.
+    with a:
+        with b:
+            pass
+
+    # Exactly the a->b edge; a stale handoff entry would add
+    # handoff->a and handoff->b (3 edges over 3 sites).
+    assert len(lockdep.edges()) == 1
+    sites = {site for edge in lockdep.edges() for site in edge}
+    assert len(sites) == 2
+    assert lockdep.find_cycles() == []
+
+    import io
+    buf = io.StringIO()
+    assert lockdep.report(file=buf) is True  # unmatched release != cycle
+    assert "UNMATCHED RELEASE" in buf.getvalue()
+
+
+def test_handoff_credit_keyed_to_acquiring_thread(lockdep_session):
+    """The prune credit belongs to the thread whose stack holds the stale
+    entry.  A third thread's later legitimate acquire/release of the same
+    lock must NOT consume it (or be misreported as unmatched)."""
+    handoff = threading.Lock()
+
+    def releaser():
+        deadline = time.time() + 5
+        while not handoff.locked() and time.time() < deadline:
+            time.sleep(0.01)
+        handoff.release()
+
+    def legit_user():
+        # fully matched acquire/release on a third thread
+        with handoff:
+            pass
+
+    t = threading.Thread(target=releaser, name="lockdep-releaser2")
+    t.start()
+    handoff.acquire()  # main acquires; stale entry lives on main's stack
+    deadline = time.time() + 5
+    while handoff.locked() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not handoff.locked(), "foreign release never happened"
+    t.join(timeout=5)
+    _run_thread(legit_user, "lockdep-legit")
+
+    # Exactly one unmatched release recorded — the handoff, not legit's
+    # (the buggy instance-global credit consumed legit's own fresh entry
+    # and misreported its matched release as a second unmatched one).
+    import io
+    buf = io.StringIO()
+    lockdep.report(file=buf)
+    assert buf.getvalue().count("UNMATCHED RELEASE") == 1
+    assert "lockdep-releaser2" in buf.getvalue()
+
+    # Main's stale entry is still pruned by main's next lock op: a later
+    # nested pair records only its own edge.
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    assert len(lockdep.edges()) == 1
+    assert lockdep.find_cycles() == []
+
+
+def test_uninstall_restores_raw_factories():
+    if lockdep.is_installed():
+        pytest.skip("ambient HOROVOD_LOCK_DEBUG session owns the install")
+    snap = lockdep.snapshot()
+    lockdep.install()
+    lockdep.uninstall()
+    lk = threading.Lock()
+    assert not isinstance(lk, lockdep._Instrumented)
+    lockdep.restore(snap)
+
+
+def test_requested_reads_env_knob(monkeypatch):
+    from horovod_tpu.common import env as env_mod
+
+    monkeypatch.delenv(env_mod.HOROVOD_LOCK_DEBUG, raising=False)
+    assert not lockdep.requested()
+    monkeypatch.setenv(env_mod.HOROVOD_LOCK_DEBUG, "1")
+    assert lockdep.requested()
+    monkeypatch.setenv(env_mod.HOROVOD_LOCK_DEBUG, "0")
+    assert not lockdep.requested()
