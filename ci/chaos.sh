@@ -3,7 +3,12 @@
 # suite standalone — deterministic kill/hang/drop/starve faults against
 # np=2/np=4 worker jobs, asserting the no-hang property (coordinated
 # errors on all survivors, or a successful elastic recovery) under
-# per-test wall-clock bounds.
+# per-test wall-clock bounds.  The integrity-plane cases (wire-CRC
+# corruption, truncated frames, kill-mid-ckpt.save, and the elastic
+# corruption-recovery bit-identical proof) ride the same lane; suite
+# order keeps them AFTER the fast in-process spec tests and np=2/np=4
+# abort cases, per the tier-1 budget rule — heavy multiprocess tests run
+# late so DOTS_PASSED comparison stays meaningful on the 1-core box.
 #
 #   sh ci/chaos.sh [extra pytest args...]
 #

@@ -60,6 +60,12 @@ HOROVOD_TPU_METADATA_URL = "HOROVOD_TPU_METADATA_URL"
 HOROVOD_TCP_PROGRESS_DEADLINE = "HOROVOD_TCP_PROGRESS_DEADLINE_SECS"
 # Deterministic fault injection spec (common/faults.py); unset = no-op.
 HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
+# -- integrity plane --
+# Wire CRC ("1"/"0", default on): every mesh frame (control frames
+# included) carries crc32(payload) in the header; a recv-side mismatch is
+# a FrameCorruptError + coordinated abort (docs/integrity.md).  All ranks
+# must agree — the launcher env propagates it like every other knob.
+HOROVOD_WIRE_CRC = "HOROVOD_WIRE_CRC"
 # Elastic blacklist cooldown: a blacklisted host rejoins the candidate
 # pool after this many seconds (0 = permanent, the reference behavior).
 HOROVOD_BLACKLIST_COOLDOWN_SECS = "HOROVOD_BLACKLIST_COOLDOWN_SECS"

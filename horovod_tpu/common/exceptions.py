@@ -79,3 +79,42 @@ class CoordinatedAbortError(HorovodInternalError):
 class FaultInjectedError(HorovodInternalError):
     """Raised by ``common/faults.py`` for ``action=raise`` — rides every
     path a real collective failure does (elastic rollback included)."""
+
+
+class FrameCorruptError(HorovodInternalError):
+    """A received mesh frame failed its wire CRC (``transport/tcp.py``).
+
+    Resync is impossible by design: once one frame's bytes are wrong the
+    positional framing after it cannot be trusted, so the detecting rank
+    marks the peer dead, broadcasts a coordinated abort, and recovery is
+    the elastic plane's job (rollback → re-rendezvous → retry)."""
+
+    def __init__(self, peer: int, frame_index: int,
+                 expected_crc: int, got_crc: int):
+        super().__init__(
+            f"frame {frame_index} from rank {peer} failed wire CRC: "
+            f"expected 0x{expected_crc:08X}, got 0x{got_crc:08X} "
+            "(corrupted or misframed stream; aborting, resync is "
+            "impossible by design)")
+        self.peer = peer
+        self.frame_index = frame_index
+        self.expected_crc = expected_crc
+        self.got_crc = got_crc
+
+
+class TruncatedFrameError(HorovodInternalError):
+    """A frame payload ended mid-field during parse (``core/messages.py``
+    ``Reader``): the declared lengths point past the end of the buffer.
+    Typed so callers never see a raw ``struct.error`` from wire input."""
+
+
+class CheckpointNotFoundError(FileNotFoundError):
+    """``checkpoint.restore``/``restore_latest`` found no (valid)
+    snapshot.  Raised on EVERY rank (rank 0's verdict is broadcast like
+    other checkpoint errors), so callers can ``try: restore`` and fall
+    back to fresh initialization without the TOCTOU-prone
+    ``exists()`` + ``restore()`` pair.
+
+    Deliberately NOT a ``HorovodInternalError``: the elastic retry loop
+    must not treat a missing checkpoint as a recoverable collective
+    failure and spin on it."""

@@ -25,6 +25,14 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, clauses joined by ``;``)::
                exit[,CODE]     os._exit(CODE or 1) — a hard process death
                drop            skip the operation (send-only; the caller
                                silently discards the payload)
+               corrupt[,NBYTES]   flip NBYTES payload bytes IN FLIGHT
+                               (send-only): the sender's wire CRC covers
+                               the original payload, so the receiver's
+                               CRC check must catch it
+               truncate[,NBYTES]  shorten the payload by NBYTES BEFORE
+                               framing (send-only): header and CRC agree
+                               with the short payload, so only the
+                               defensive parse layer can catch it
 
 Examples::
 
@@ -34,7 +42,10 @@ Examples::
 
 Determinism: every clause keeps its own matching-call counter, so a given
 spec against a deterministic call sequence reproduces the same failure at
-the same point, run after run — no randomness anywhere.
+the same point, run after run — no randomness anywhere.  ``corrupt``'s
+byte flips are seeded from the clause's matching-call counter, so the
+same spec corrupts the same byte positions with the same XOR masks every
+run.
 
 Zero overhead when unset: ``ACTIVE`` is False and every instrumented site
 guards with ``if faults.ACTIVE:`` — the cost of an unconfigured site is one
@@ -45,9 +56,10 @@ from __future__ import annotations
 
 import errno
 import os
+import random
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from .env import HOROVOD_FAULT_SPEC, HOROVOD_RANK
 from .exceptions import FaultInjectedError
@@ -59,9 +71,16 @@ SITES = (
     "dispatch.collective",
     "rendezvous.get",
     "worker.spawn",
+    "ckpt.save",
 )
 
-_ACTIONS = ("hang", "delay_ms", "raise", "raise_oserror", "exit", "drop")
+_ACTIONS = ("hang", "delay_ms", "raise", "raise_oserror", "exit", "drop",
+            "corrupt", "truncate")
+
+#: Actions that rewrite the operation's payload instead of failing it;
+#: only ``tcp.send`` passes a payload, so they are send-only (parse-time
+#: enforced, like ``drop``).
+_PAYLOAD_ACTIONS = ("drop", "corrupt", "truncate")
 
 #: Fast-path flag: False means no spec is configured and ``inject`` is
 #: never called (sites guard on it).
@@ -153,13 +172,39 @@ def _parse_clause(text: str) -> _Clause:
                 f"unknown fault clause key {key!r} (clause: {text!r})")
     if nth is not None and after is not None:
         raise ValueError(f"nth and after are exclusive (clause: {text!r})")
-    if action == "drop" and site != "tcp.send":
-        # Only a send can be dropped (the caller skips the write); every
-        # other site would silently ignore the drop — and a spec that
-        # injects nothing must fail loudly, not pass chaos tests vacuously.
+    if action in _PAYLOAD_ACTIONS and site != "tcp.send":
+        # Only a send carries a payload to drop/mangle; every other site
+        # would silently ignore the action — and a spec that injects
+        # nothing must fail loudly, not pass chaos tests vacuously.
         raise ValueError(
-            f"action=drop is only valid for site tcp.send (clause: {text!r})")
+            f"action={action} is only valid for site tcp.send "
+            f"(clause: {text!r})")
     return _Clause(site, rank, peer, nth, after, action, action_arg)
+
+
+class SendMutation:
+    """Verdict of a payload-mangling injection on ``tcp.send``.
+
+    ``payload`` is the LOGICAL payload (post-``truncate``): the transport
+    frames and CRCs this, so a truncated frame is self-consistent and only
+    the defensive parse layer can catch it.  ``wire_flips`` are
+    (offset, xor) byte flips applied AFTER the CRC is computed
+    (``corrupt``): in-flight corruption the wire CRC must catch."""
+
+    __slots__ = ("payload", "wire_flips")
+
+    def __init__(self, payload: bytes,
+                 wire_flips: List[Tuple[int, int]]):
+        self.payload = payload
+        self.wire_flips = wire_flips
+
+    def wire_bytes(self) -> bytes:
+        if not self.wire_flips:
+            return self.payload
+        buf = bytearray(self.payload)
+        for off, mask in self.wire_flips:
+            buf[off] ^= mask
+        return bytes(buf)
 
 
 def configure(spec: Optional[str]) -> None:
@@ -189,24 +234,61 @@ def _default_rank() -> int:
 
 
 def inject(site: str, rank: Optional[int] = None,
-           peer: Optional[int] = None) -> bool:
+           peer: Optional[int] = None, payload: Optional[bytes] = None):
     """Fire any matching clause for this call.
 
-    Returns True when the caller should DROP the operation (``action=drop``);
-    raising/hanging/exiting actions never return.  Sites guard the call with
-    ``if faults.ACTIVE:`` so the disabled path costs one attribute read.
+    Returns ``False`` when nothing payload-affecting fired, ``True`` when
+    the caller should DROP the operation (``action=drop``), or a
+    :class:`SendMutation` when ``corrupt``/``truncate`` rewrote the
+    ``payload`` the caller passed; raising/hanging/exiting actions never
+    return.  Sites guard the call with ``if faults.ACTIVE:`` so the
+    disabled path costs one attribute read.
     """
     if rank is None:
         rank = _default_rank()
-    drop = False
     fire: List[_Clause] = []
     with _lock:
         for clause in _clauses:
             if clause.matches(site, rank, peer) and clause.should_fire():
                 fire.append(clause)
+    drop = False
+    mutation: Optional[SendMutation] = None
     for clause in fire:
-        drop = _run_action(clause, site, rank) or drop
-    return drop
+        if clause.action in ("corrupt", "truncate"):
+            if payload is None:
+                continue  # parse-time guard keeps these on tcp.send
+            if mutation is None:
+                mutation = SendMutation(payload, [])
+            _mutate_payload(clause, mutation)
+        else:
+            drop = _run_action(clause, site, rank) or drop
+    if drop:
+        return True  # drop wins over a concurrent mutation
+    return mutation if mutation is not None else False
+
+
+def _mutate_payload(clause: _Clause, mutation: SendMutation) -> None:
+    """Apply one corrupt/truncate clause to the pending SendMutation.
+
+    Determinism: the flip positions/masks derive only from the clause's
+    matching-call counter (and payload length), so the same spec against
+    the same call sequence reproduces bit-identical corruption."""
+    nbytes = int(clause.action_arg or "1")
+    if clause.action == "truncate":
+        mutation.payload = mutation.payload[:max(
+            0, len(mutation.payload) - nbytes)]
+        # Flips past the new end would be out of range.
+        mutation.wire_flips = [
+            (off, m) for off, m in mutation.wire_flips
+            if off < len(mutation.payload)]
+        return
+    if not mutation.payload:
+        return  # nothing to corrupt in an empty payload
+    rng = random.Random(clause.calls)
+    for _ in range(nbytes):
+        off = rng.randrange(len(mutation.payload))
+        mask = rng.randrange(1, 256)  # never a zero mask (a no-op flip)
+        mutation.wire_flips.append((off, mask))
 
 
 def _run_action(clause: _Clause, site: str, rank: int) -> bool:

@@ -22,9 +22,16 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..common.exceptions import TruncatedFrameError
+
 WIRE_MAGIC = 0x48564454  # "HVDT"
 MASK_MAGIC = 0x4B53414D  # "MASK" — steady-state fast-path frame
 ABORT_MAGIC = 0x54524241  # "ABRT" — coordinated-abort control frame
+
+#: AbortFrame.reason budget (bytes, UTF-8): an abort carrying a giant
+#: traceback must not bloat the control frame every surviving link relays.
+MAX_ABORT_REASON_BYTES = 512
+_TRUNCATION_MARK = "…[truncated]"
 
 
 class DataType(enum.IntEnum):
@@ -151,13 +158,31 @@ class Writer:
 
 
 class Reader:
+    """Bounds-checked binary reader.
+
+    Wire input is UNTRUSTED even inside the CRC envelope: a truncated
+    application frame (misframed sender, injected ``truncate`` fault)
+    passes the transport CRC — it was computed over the short payload —
+    and arrives here with length fields pointing past the buffer end.
+    Every read therefore checks its bounds and raises typed
+    :class:`TruncatedFrameError` instead of leaking a raw
+    ``struct.error`` (or, worse, silently slicing short)."""
+
     __slots__ = ("buf", "pos")
 
     def __init__(self, buf: bytes):
         self.buf = buf
         self.pos = 0
 
+    def _need(self, size: int) -> None:
+        if self.pos + size > len(self.buf):
+            raise TruncatedFrameError(
+                f"frame truncated: need {size} bytes at offset {self.pos} "
+                f"but only {len(self.buf) - self.pos} remain "
+                f"(buffer is {len(self.buf)} bytes)")
+
     def _take(self, fmt: str, size: int):
+        self._need(size)
         v = struct.unpack_from(fmt, self.buf, self.pos)[0]
         self.pos += size
         return v
@@ -168,26 +193,47 @@ class Reader:
     def i64(self) -> int: return self._take("<q", 8)
     def f64(self) -> float: return self._take("<d", 8)
 
+    def bytes_(self, n: int) -> bytes:
+        """Exactly ``n`` raw bytes — a short slice would silently
+        misparse everything after it."""
+        self._need(n)
+        out = bytes(self.buf[self.pos:self.pos + n])
+        self.pos += n
+        return out
+
     def string(self) -> str:
         n = self.u32()
-        s = self.buf[self.pos:self.pos + n].decode("utf-8")
-        self.pos += n
-        return s
+        return self.bytes_(n).decode("utf-8")
 
     def i64_list(self) -> List[int]:
         n = self.u32()
+        self._need(8 * n)
         out = list(struct.unpack_from(f"<{n}q", self.buf, self.pos))
         self.pos += 8 * n
         return out
 
     def i32_list(self) -> List[int]:
         n = self.u32()
+        self._need(4 * n)
         out = list(struct.unpack_from(f"<{n}i", self.buf, self.pos))
         self.pos += 4 * n
         return out
 
     def str_list(self) -> List[str]:
         return [self.string() for _ in range(self.u32())]
+
+    def expect_magic(self, expected: int, what: str) -> None:
+        """Check the leading u32 wire tag; a mismatch reports got vs
+        expected plus a hexdump of the frame head — the diagnostic that
+        distinguishes "wrong frame type" from "stream desync" at a
+        glance."""
+        got = self.u32()
+        if got != expected:
+            head = self.buf[:16].hex(" ")
+            raise ValueError(
+                f"bad {what} magic: got 0x{got:08X}, expected "
+                f"0x{expected:08X}; first {min(16, len(self.buf))} bytes: "
+                f"{head}")
 
 
 # ---------------------------------------------------------------------------
@@ -285,13 +331,10 @@ class RequestList:
     @staticmethod
     def from_bytes(data: bytes) -> "RequestList":
         r = Reader(data)
-        if r.u32() != WIRE_MAGIC:
-            raise ValueError("bad request-list magic")
+        r.expect_magic(WIRE_MAGIC, "request-list")
         shutdown = bool(r.u8())
         cache_hits = r.i32_list()
-        mask_len = r.u32()
-        mask = bytes(r.buf[r.pos:r.pos + mask_len])
-        r.pos += mask_len
+        mask = r.bytes_(r.u32())
         reqs = [Request.deserialize(r) for _ in range(r.u32())]
         return RequestList(requests=reqs, shutdown=shutdown,
                            cache_hits=cache_hits, cache_mask=mask)
@@ -329,12 +372,9 @@ class MaskFrame:
     @staticmethod
     def from_bytes(data: bytes) -> "MaskFrame":
         r = Reader(data)
-        if r.u32() != MASK_MAGIC:
-            raise ValueError("bad mask-frame magic")
+        r.expect_magic(MASK_MAGIC, "mask-frame")
         shutdown = bool(r.u8())
-        n = r.u32()
-        return MaskFrame(mask=bytes(r.buf[r.pos:r.pos + n]),
-                         shutdown=shutdown)
+        return MaskFrame(mask=r.bytes_(r.u32()), shutdown=shutdown)
 
     @property
     def mask_int(self) -> int:
@@ -363,6 +403,20 @@ class AbortFrame:
     origin_rank: int = 0
     reason: str = ""
 
+    def __post_init__(self):
+        # Bound the reason AT CONSTRUCTION (not serialization): the cap
+        # must hold everywhere the frame travels — relays, logs, the mesh
+        # abort flag — not just on this rank's wire.  A multi-KB
+        # traceback in every control frame would bloat exactly the path
+        # that must stay small to deliver promptly during teardown.
+        raw = self.reason.encode("utf-8")
+        if len(raw) > MAX_ABORT_REASON_BYTES:
+            mark = _TRUNCATION_MARK.encode("utf-8")
+            keep = raw[:MAX_ABORT_REASON_BYTES - len(mark)]
+            # errors="ignore" drops a multi-byte sequence split by the
+            # cut instead of raising (or keeping a mojibake tail).
+            self.reason = keep.decode("utf-8", "ignore") + _TRUNCATION_MARK
+
     def to_bytes(self) -> bytes:
         w = Writer()
         w.u32(ABORT_MAGIC)
@@ -374,8 +428,7 @@ class AbortFrame:
     @staticmethod
     def from_bytes(data: bytes) -> "AbortFrame":
         r = Reader(data)
-        if r.u32() != ABORT_MAGIC:
-            raise ValueError("bad abort-frame magic")
+        r.expect_magic(ABORT_MAGIC, "abort-frame")
         return AbortFrame(epoch=r.i64(), origin_rank=r.i32(),
                           reason=r.string())
 
@@ -465,8 +518,7 @@ class ResponseList:
     @staticmethod
     def from_bytes(data: bytes) -> "ResponseList":
         r = Reader(data)
-        if r.u32() != WIRE_MAGIC:
-            raise ValueError("bad response-list magic")
+        r.expect_magic(WIRE_MAGIC, "response-list")
         shutdown = bool(r.u8())
         evicted = r.i32_list()
         assignments = []

@@ -45,6 +45,7 @@ from .constants import (
     ELASTIC_TIMEOUT_SECS,
     TRANSIENT_EXIT_CODE,
 )
+from . import rendezvous_client
 from .discovery import HostManager
 from .registration import WorkerStateRegistry
 from .worker import WORKERS_SCOPE, WorkerNotificationClient
@@ -238,7 +239,8 @@ class ElasticDriver:
                 missing_workers = {
                     f"{s.hostname}:{s.local_rank}" for s in self._slots
                 } - set(self._known_identities)
-            if not changed and not missing_workers:
+            reset_reasons = self._pending_reset_requests()
+            if not changed and not missing_workers and not reset_reasons:
                 continue
             if self.reset_limit is not None and \
                     self.resets >= self.reset_limit:
@@ -251,12 +253,43 @@ class ElasticDriver:
                 log.warning("host change leaves fewer than min_np slots; "
                             "waiting for capacity")
                 continue
-            removalish = removal or bool(missing_workers)
-            log.info("host set changed (removal=%s, dead_workers=%s); "
-                     "advancing epoch", removal, sorted(missing_workers))
+            # A worker-initiated reset (e.g. corruption abort with every
+            # process still alive) is removal-LIKE for sync purposes: the
+            # workers rolled back and must state.sync() after the reset.
+            removalish = removal or bool(missing_workers) \
+                or bool(reset_reasons)
+            log.info("host set changed (removal=%s, dead_workers=%s, "
+                     "reset_requests=%s); advancing epoch",
+                     removal, sorted(missing_workers), reset_reasons)
             self._rendezvous_epoch()
             self._await_ack = not removalish  # remember flavor for re-notify
             self._notify_workers(added_only=not removalish)
+
+    def _pending_reset_requests(self) -> List[str]:
+        """Worker-posted epoch-reset requests for the CURRENT epoch.
+
+        The integrity plane's recovery trigger: a corruption abort leaves
+        every worker alive-but-rolled-back, waiting for an epoch that no
+        exit or host change would ever produce.  A request stamped with an
+        OLDER epoch was already answered by a later bump and is ignored —
+        the same staleness rule the abort frames use."""
+        reasons = []
+        with self._lock:
+            identities = {f"{s.hostname}:{s.local_rank}"
+                          for s in self._slots}
+        for identity in sorted(identities):
+            raw = self.rendezvous.get(
+                rendezvous_client.RESET_REQUEST_SCOPE, identity)
+            if raw is None:
+                continue
+            try:
+                req = json.loads(raw.decode())
+            except ValueError:
+                continue
+            if req.get("epoch", -1) == self.epoch:
+                reasons.append(
+                    f"{identity}: {req.get('reason', 'unspecified')}")
+        return reasons
 
     # ------------------------------------------------------------------
 

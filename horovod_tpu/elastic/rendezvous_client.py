@@ -18,12 +18,47 @@ from ..common.topology import ProcessTopology
 from ..transport.store import HTTPStoreClient
 
 RANK_AND_SIZE_SCOPE = "rank_and_size"
+#: Worker → driver back-channel: a surviving-but-aborted worker posts
+#: ``{"epoch": N, "reason": ...}`` here to ask for a fresh membership
+#: epoch (see ``request_reset``).  The driver treats a CURRENT-epoch
+#: request like a membership change: advance, publish, notify.
+RESET_REQUEST_SCOPE = "reset_request"
 
 
 def _identity() -> str:
     hostname = env_mod.get_str(env_mod.HOROVOD_HOSTNAME) or "localhost"
     local_rank = env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)
     return f"{hostname}:{local_rank}"
+
+
+def request_reset(reason: str) -> bool:
+    """Ask the elastic driver to advance the membership epoch.
+
+    The gap this fills (integrity plane): after a CORRUPTION abort every
+    worker process is still alive, so the driver sees no exit and no host
+    change — nothing would ever publish the new epoch the survivors'
+    ``refresh_topology_from_rendezvous`` is waiting for.  Posting the
+    request makes an all-survivors abort recover in one discovery tick
+    instead of timing out into TRANSIENT-exit respawns.
+
+    Best-effort and epoch-stamped: the driver only honors a request
+    carrying its CURRENT epoch (anything older was answered by a later
+    bump already).  Returns whether the request was posted."""
+    addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        return False
+    payload = json.dumps({"epoch": env_mod.get_epoch(),
+                          "reason": reason[:512]}).encode()
+    try:
+        HTTPStoreClient(addr, port).set(
+            RESET_REQUEST_SCOPE, _identity(), payload)
+        return True
+    except Exception:  # noqa: BLE001 — the retry loop falls back to the
+        # slow path (reinit timeout → transient exit → respawn) if the
+        # store is unreachable; failing the fast path must not mask the
+        # original error being recovered from.
+        return False
 
 
 def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:

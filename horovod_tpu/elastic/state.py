@@ -299,6 +299,28 @@ def negotiate_jax_coordinator(topo) -> str:
         _time.sleep(0.25)
 
 
+def _request_epoch_reset(err: BaseException) -> None:
+    """Best-effort worker→driver epoch-reset request (elastic jobs only;
+    static jobs have no driver and surface the error to the caller).
+
+    Deliberately fires for EVERY HorovodInternalError, not just
+    corruption aborts: any all-survivors abort (wire corruption, stall
+    shutdown, a deadline trip on a wedged-but-alive peer) leaves no
+    process exit for the driver to react to.  When the failure WAS a
+    process death, the request can race the driver's exit monitor and
+    cost one spurious epoch bump (the dead identity is respawned one
+    epoch later) — a bounded waste that self-corrects, accepted over the
+    alternative of filtering by error type and silently breaking
+    recovery for whichever alive-abort flavor the filter missed."""
+    from ..common import env as env_mod
+
+    if not env_mod.get_bool(env_mod.HOROVOD_ELASTIC):
+        return
+    from .rendezvous_client import request_reset
+
+    request_reset(f"{type(err).__name__}: {err}")
+
+
 def _teardown() -> None:
     """Best-effort runtime teardown; never raises (used between retries)."""
     try:
@@ -362,9 +384,17 @@ def run(func: Callable) -> Callable:
                 if not skip_sync:
                     state.sync()
                 return func(state, *args, **kwargs)
-            except HorovodInternalError:
+            except HorovodInternalError as e:
                 state.restore()
                 skip_sync = False
+                # Integrity-plane recovery trigger: a corruption abort
+                # (FrameCorruptError / CoordinatedAbortError relaying one)
+                # leaves EVERY worker alive, so no exit or host change
+                # would ever produce the new epoch the retry below waits
+                # for.  Ask the driver for one; stale/duplicate requests
+                # are epoch-filtered driver-side, and a dead store just
+                # falls back to the slow transient-exit path.
+                _request_epoch_reset(e)
             except HostsUpdatedInterrupt as e:
                 skip_sync = e.skip_sync
             resets += 1

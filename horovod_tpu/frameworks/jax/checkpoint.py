@@ -8,22 +8,54 @@ through ``broadcast_parameters``/``broadcast_object``
 format is orbax (the jax-ecosystem checkpointer — async-capable,
 pytree-aware) instead of framework-specific savers.
 
+Integrity plane (docs/integrity.md): bytes on disk are verified, not
+trusted.  Every snapshot is published ATOMICALLY (orbax writes to a temp
+path, ``os.replace`` moves it into place) and committed by a sidecar
+manifest carrying a CRC32 over the payload files plus step metadata —
+written LAST, so "manifest present and CRC matches" is the durable
+definition of a valid snapshot.  A crash at any point leaves either the
+previous snapshot intact or an invalid (manifest-less / CRC-mismatched)
+one that :func:`restore_latest` detects, logs, and skips — the
+CheckFreq/Gemini argument that RECOVERY, not detection, is what keeps a
+failure from amplifying at scale.
+
 Usage::
 
     hvd_ckpt.save(path, {"params": params, "opt": opt_state, "step": 5})
     restored = hvd_ckpt.restore(path, like={"params": params, ...})
 
+    # Rotating self-healing flavor:
+    hvd_ckpt.save_rotating(base, state, keep=3)
+    restored = hvd_ckpt.restore_latest(base, like=state)
+
 ``save`` writes on rank 0 and barriers; ``restore`` reads on rank 0 and
 broadcasts, so all ranks return identical state even when the checkpoint
-directory is only visible to rank 0's host.
+directory is only visible to rank 0's host.  A missing (or nowhere-valid)
+checkpoint raises :class:`CheckpointNotFoundError` on EVERY rank — prefer
+``try: restore(...) except CheckpointNotFoundError: <fresh init>`` over
+the TOCTOU-prone ``exists()`` + ``restore()`` pair (``exists`` remains for
+cheap UI-level checks).
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import json
+import os
+import re
+import shutil
+import zlib
+from typing import Any, List, Optional, Tuple
 
+from ...common import faults
+from ...common.exceptions import CheckpointNotFoundError
+from ...common.logging_util import get_logger
 from . import functions as _functions
 from .basics import rank
+
+log = get_logger("horovod_tpu.frameworks.jax.checkpoint")
+
+MANIFEST_SUFFIX = ".manifest.json"
+_SEQ_RE = re.compile(r"\.(\d{8})$")
 
 
 def _checkpointer():
@@ -32,18 +64,165 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+# ---------------------------------------------------------------------------
+# rank-0-local snapshot primitives (no collectives — unit-testable)
+# ---------------------------------------------------------------------------
+
+def _manifest_path(path: str) -> str:
+    return path + MANIFEST_SUFFIX
+
+
+def _payload_crc(path: str) -> Tuple[int, int, int]:
+    """CRC32 over every payload file, walked in sorted relpath order (the
+    relpaths themselves feed the CRC too, so a renamed or missing file
+    changes it).  Returns ``(crc, total_bytes, file_count)``."""
+    crc = 0
+    total = 0
+    count = 0
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames.sort()
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, path)
+            crc = zlib.crc32(rel.encode("utf-8"), crc)
+            with open(full, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    crc = zlib.crc32(chunk, crc)
+                    total += len(chunk)
+            count += 1
+    return crc & 0xFFFFFFFF, total, count
+
+
+def _step_of(state: Any) -> Optional[int]:
+    """Best-effort step metadata for the manifest (a dict-shaped state
+    with a ``step`` leaf is the dominant idiom)."""
+    try:
+        return int(state["step"])  # works for int, np/jnp scalars
+    except Exception:  # noqa: BLE001 — metadata only, never fails a save
+        return None
+
+
+def _publish_snapshot(path: str, state: Any,
+                      step: Optional[int] = None) -> dict:
+    """Atomically publish ``state`` at ``path`` (rank-0-local).
+
+    Write order is the commit protocol:
+
+    1. orbax-write the tree to ``<path>.tmp-<pid>`` (a crash here leaves
+       only an ignorable temp dir);
+    2. CRC the temp payload;
+    3. ``os.replace`` it to ``path`` (atomic — readers never observe a
+       half-copied tree);
+    4. write the sidecar manifest via its own temp + ``os.replace``.
+
+    The manifest is LAST: until it lands, the snapshot does not exist as
+    far as :func:`restore_latest`/:func:`restore` verification is
+    concerned, so a crash between 3 and 4 is detected, logged, and
+    skipped instead of restored.  The ``ckpt.save`` fault site sits
+    exactly in that window — the kill-mid-write chaos test's scalpel.
+    """
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)  # stale leftover of a previous crashed attempt
+    _checkpointer().save(tmp, state)
+    crc, nbytes, nfiles = _payload_crc(tmp)
+    manifest = {
+        "format": 1,
+        "crc32": crc,
+        "bytes": nbytes,
+        "files": nfiles,
+        "step": step if step is not None else _step_of(state),
+    }
+    if os.path.exists(path):
+        # Overwrite protocol: move the OLD payload aside atomically, then
+        # delete it out of band.  Never rmtree in place — a crash
+        # mid-rmtree would leave a half-deleted tree at the published
+        # path with no manifest, which restore()'s pre-manifest compat
+        # branch would load unverified.  With the move-aside, every
+        # crash window leaves `path` either absent (typed not-found),
+        # the complete old tree, or the complete new tree.
+        old = f"{path}.old-{os.getpid()}"
+        if os.path.isdir(old):
+            shutil.rmtree(old)  # stale aside-dir from a crashed attempt
+        os.replace(path, old)
+        _remove_quiet(_manifest_path(path))
+        shutil.rmtree(old, ignore_errors=True)
+    os.replace(tmp, path)
+    if faults.ACTIVE:
+        faults.inject("ckpt.save")
+    mtmp = f"{_manifest_path(path)}.tmp-{os.getpid()}"
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(mtmp, _manifest_path(path))
+    return manifest
+
+
+def _remove_quiet(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def snapshot_valid(path: str) -> Tuple[bool, str]:
+    """Is the snapshot at ``path`` restorable?  ``(ok, reason)`` — the
+    reason names what failed (missing manifest, CRC mismatch, ...) so
+    :func:`restore_latest`'s skip log is actionable."""
+    if not os.path.isdir(path):
+        return False, "payload directory missing"
+    mpath = _manifest_path(path)
+    if not os.path.exists(mpath):
+        return False, "no manifest (half-written: crashed before commit)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"manifest unreadable: {e}"
+    crc, nbytes, nfiles = _payload_crc(path)
+    if crc != manifest.get("crc32") or nfiles != manifest.get("files"):
+        return False, (
+            f"payload CRC mismatch: manifest says crc32=0x"
+            f"{manifest.get('crc32', 0):08X}/{manifest.get('files')} files,"
+            f" disk has 0x{crc:08X}/{nfiles} files")
+    return True, "ok"
+
+
+def _list_snapshots(base: str) -> List[Tuple[int, str]]:
+    """Rotating snapshots under ``base``, newest (highest seq) first."""
+    parent = os.path.dirname(base) or "."
+    prefix = os.path.basename(base)
+    found = []
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return []
+    for name in entries:
+        if not name.startswith(prefix + "."):
+            continue
+        m = _SEQ_RE.search(name)
+        if m and name == f"{prefix}.{m.group(1)}":
+            found.append((int(m.group(1)), os.path.join(parent, name)))
+    return sorted(found, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# distributed API (rank 0 does I/O; verdicts and state broadcast)
+# ---------------------------------------------------------------------------
+
 def save(path: str, state: Any) -> None:
-    """Rank-0-only durable write; completion (or rank 0's FAILURE) is
-    broadcast so no rank proceeds — or hangs — on a half-written
-    checkpoint.  A rank-0 storage error re-raises on EVERY rank."""
+    """Rank-0-only durable write with atomic publish + CRC manifest;
+    completion (or rank 0's FAILURE) is broadcast so no rank proceeds —
+    or hangs — on a half-written checkpoint.  A rank-0 storage error
+    re-raises on EVERY rank."""
     err = None
     if rank() == 0:
-        import os
-
         try:
-            _checkpointer().save(os.path.abspath(path), state, force=True)
+            _publish_snapshot(os.path.abspath(path), state)
         except BaseException as e:  # noqa: BLE001 — marshalled to peers
-            err = f"{type(e).__name__}: {e}"
+            err = ("internal", f"{type(e).__name__}: {e}")
     _raise_if_root_failed(err, "ckpt.save")
 
 
@@ -51,42 +230,131 @@ def restore(path: str, like: Optional[Any] = None) -> Any:
     """Rank 0 reads, every rank receives the identical pytree (or rank
     0's read error, re-raised everywhere instead of deadlocking peers).
 
-    ``like`` (a pytree of the expected structure) lets orbax restore
-    typed arrays; without it the raw stored tree is returned."""
+    A missing checkpoint raises :class:`CheckpointNotFoundError` on every
+    rank; a present-but-corrupt one (manifest CRC mismatch) raises
+    ``HorovodInternalError`` naming what failed.  ``like`` (a pytree of
+    the expected structure) lets orbax restore typed arrays; without it
+    the raw stored tree is returned."""
     state, err = None, None
     if rank() == 0:
-        import os
-
-        try:
-            ckpt = _checkpointer()
-            abspath = os.path.abspath(path)
-            state = ckpt.restore(abspath, item=like) if like is not None \
-                else ckpt.restore(abspath)
-        except BaseException as e:  # noqa: BLE001 — marshalled to peers
-            err = f"{type(e).__name__}: {e}"
+        abspath = os.path.abspath(path)
+        if not os.path.exists(abspath):
+            err = ("not_found", f"no checkpoint at {abspath}")
+        else:
+            try:
+                if os.path.exists(_manifest_path(abspath)):
+                    ok, reason = snapshot_valid(abspath)
+                    if not ok:
+                        raise IOError(
+                            f"checkpoint {abspath} failed integrity "
+                            f"verification: {reason}")
+                # Pre-manifest checkpoints (no sidecar) restore
+                # unverified, for compatibility.
+                state = _restore_payload(abspath, like)
+            except BaseException as e:  # noqa: BLE001 — marshalled to peers
+                err = ("internal", f"{type(e).__name__}: {e}")
     _raise_if_root_failed(err, "ckpt.restore")
     return _functions.broadcast_object(state, root_rank=0,
                                        name="ckpt.restore.state")
 
 
+def _restore_payload(abspath: str, like: Optional[Any]) -> Any:
+    ckpt = _checkpointer()
+    return ckpt.restore(abspath, item=like) if like is not None \
+        else ckpt.restore(abspath)
+
+
+def save_rotating(base: str, state: Any, keep: int = 3,
+                  step: Optional[int] = None) -> str:
+    """Publish a NEW snapshot ``<base>.<seq>`` (monotonic 8-digit seq) and
+    prune, keeping the newest ``keep``.  Returns the published path on
+    every rank.  Combined with :func:`restore_latest`, a corrupted or
+    half-written newest snapshot costs one checkpoint interval of
+    progress, never the run."""
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1 (got {keep})")
+    err, published = None, None
+    if rank() == 0:
+        try:
+            abs_base = os.path.abspath(base)
+            snaps = _list_snapshots(abs_base)
+            seq = (snaps[0][0] + 1) if snaps else 1
+            published = f"{abs_base}.{seq:08d}"
+            _publish_snapshot(published, state, step=step)
+            for _, old in _list_snapshots(abs_base)[keep:]:
+                shutil.rmtree(old, ignore_errors=True)
+                _remove_quiet(_manifest_path(old))
+        except BaseException as e:  # noqa: BLE001 — marshalled to peers
+            err = ("internal", f"{type(e).__name__}: {e}")
+    _raise_if_root_failed(err, "ckpt.save_rotating")
+    return _functions.broadcast_object(published, root_rank=0,
+                                       name="ckpt.save_rotating.path")
+
+
+def restore_latest(base: str, like: Optional[Any] = None) -> Any:
+    """Restore the newest VALID rotating snapshot under ``base``.
+
+    Rank 0 walks the snapshots newest-first, verifying each manifest
+    (and surviving an orbax read error on a lying-but-CRC-clean tree):
+    invalid ones are logged and skipped — this is the self-healing path
+    for a crash mid-``save_rotating`` or at-rest corruption.  Raises
+    :class:`CheckpointNotFoundError` everywhere when no valid snapshot
+    exists."""
+    state, err = None, None
+    if rank() == 0:
+        abs_base = os.path.abspath(base)
+        snaps = _list_snapshots(abs_base)
+        restored = False
+        for _, snap in snaps:
+            ok, reason = snapshot_valid(snap)
+            if not ok:
+                log.warning("restore_latest: skipping snapshot %s: %s",
+                            snap, reason)
+                continue
+            try:
+                state = _restore_payload(snap, like)
+            except BaseException as e:  # noqa: BLE001 — fall back further
+                log.warning("restore_latest: snapshot %s verified but "
+                            "failed to load (%s: %s); falling back",
+                            snap, type(e).__name__, e)
+                continue
+            log.info("restore_latest: restored %s", snap)
+            restored = True
+            break
+        if not restored:
+            err = ("not_found",
+                   f"no valid snapshot under {abs_base} "
+                   f"({len(snaps)} candidates examined)")
+    _raise_if_root_failed(err, "ckpt.restore_latest")
+    return _functions.broadcast_object(state, root_rank=0,
+                                       name="ckpt.restore_latest.state")
+
+
 def exists(path: str) -> bool:
-    """Rank-0 check, broadcast — every rank agrees whether to resume."""
+    """Rank-0 check, broadcast — every rank agrees whether a checkpoint
+    is present.  NOTE: ``exists()`` + ``restore()`` is TOCTOU-prone (the
+    file can vanish or be found corrupt between the calls); prefer
+    catching :class:`CheckpointNotFoundError` from ``restore``/
+    ``restore_latest`` and falling back to fresh initialization."""
     present = False
     if rank() == 0:
-        import os
-
         present = os.path.exists(path)
     return bool(_functions.broadcast_object(present, root_rank=0,
                                             name="ckpt.exists"))
 
 
-def _raise_if_root_failed(err: Optional[str], name: str) -> None:
-    """Broadcast rank 0's error status; every rank raises together (a
-    bare barrier would leave peers waiting forever when root died before
-    reaching it)."""
+def _raise_if_root_failed(err: Optional[Tuple[str, str]],
+                          name: str) -> None:
+    """Broadcast rank 0's ``(kind, message)`` verdict; every rank raises
+    the same typed error together (a bare barrier would leave peers
+    waiting forever when root died before reaching it)."""
     status = _functions.broadcast_object(err, root_rank=0,
                                          name=f"{name}.status")
-    if status is not None:
-        from ...common.exceptions import HorovodInternalError
+    if status is None:
+        return
+    kind, message = status
+    if kind == "not_found":
+        raise CheckpointNotFoundError(message)
+    from ...common.exceptions import HorovodInternalError
 
-        raise HorovodInternalError(f"rank 0 checkpoint I/O failed: {status}")
+    raise HorovodInternalError(f"rank 0 checkpoint I/O failed: {message}")

@@ -448,16 +448,28 @@ class SwallowedThreadException(Rule):
 
 class WireTagInvariants(Rule):
     """Frames are distinguished on the wire ONLY by their leading magic,
-    and the transport's 8-byte length header reserves its top bit for
-    control frames (AbortFrame).  Two classes sharing a magic, a frame
-    class without one, or messages.py reaching for the control bit all
-    produce positional-framing desyncs that surface as 'survivors read
-    negotiation bytes as tensor data'."""
+    and the transport's frame header is ``<Q len|flags><I crc32>`` — the
+    length word's top bit reserved for control frames (AbortFrame), the
+    CRC field owned by the transport alone.  Two classes sharing a magic,
+    a frame class without one, messages.py reaching for the control bit
+    or computing its own wire CRC, or the transport's header structs
+    drifting from the documented layout all produce positional-framing
+    desyncs (or silently unverified bytes) that surface as 'survivors
+    read negotiation bytes as tensor data'."""
 
     code = "HVD005"
-    title = "control-frame wire-tag invariant (core/messages.py)"
+    title = "wire framing invariant (core/messages.py, transport/tcp.py)"
+
+    #: The frame-header layout contract (docs/integrity.md): the length
+    #: word and the CRC field each live in exactly one module-level
+    #: struct, with these formats.  Changing either silently desyncs
+    #: every peer built from a different revision.
+    _HEADER_STRUCTS = {"_LEN": "<Q", "_CRC": "<I"}
 
     def check(self, ctx, project):
+        if ctx.rel_path.endswith("transport/tcp.py"):
+            yield from self._check_transport_header(ctx)
+            return
         if not ctx.rel_path.endswith("core/messages.py"):
             return
         magics: Dict[str, Tuple[int, ast.AST]] = {}
@@ -496,6 +508,56 @@ class WireTagInvariants(Rule):
                     "bit (1 << 63): it is the transport's control-frame "
                     "flag, reserved for AbortFrame marking in "
                     "transport/tcp.py")
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) == "crc32":
+                yield self._v(
+                    ctx, node,
+                    "core/messages.py must not compute wire CRCs: the "
+                    "integrity envelope is the transport's _CRC header "
+                    "field (one layer, one owner — a second checksum "
+                    "here would drift from it)")
+
+    def _check_transport_header(self, ctx) -> Iterator[Violation]:
+        """transport/tcp.py owns the frame header: ``_LEN``/``_CRC``
+        structs with the documented formats, and the ``_CTRL_FLAG = 1 <<
+        63`` reservation, must all exist exactly as declared — the wire
+        contract every peer and every doc (docs/integrity.md) assumes."""
+        structs: Dict[str, object] = {}
+        ctrl_ok = False
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call) \
+                        and _terminal_name(v.func) == "Struct" \
+                        and v.args and isinstance(v.args[0], ast.Constant):
+                    structs[tgt.id] = (v.args[0].value, node)
+                if tgt.id == "_CTRL_FLAG" \
+                        and self._ctrl_bit_literal(v) is not None:
+                    ctrl_ok = True
+        for name, fmt in self._HEADER_STRUCTS.items():
+            got = structs.get(name)
+            if got is None:
+                yield Violation(
+                    self.code, ctx.path, 1, 0,
+                    f"transport/tcp.py must declare {name} = "
+                    f"struct.Struct({fmt!r}) (frame-header layout "
+                    "contract: <Q len|flags><I crc32>)")
+            elif got[0] != fmt:
+                yield self._v(
+                    ctx, got[1],
+                    f"frame-header struct {name} must use format {fmt!r} "
+                    f"(found {got[0]!r}); peers built from a different "
+                    "layout desync on every frame")
+        if not ctrl_ok:
+            yield Violation(
+                self.code, ctx.path, 1, 0,
+                "transport/tcp.py must reserve the length-header top bit "
+                "as _CTRL_FLAG = 1 << 63 (the control-frame marking "
+                "AbortFrame delivery depends on)")
 
     #: every Writer method that appends bytes — the magic must precede
     #: ALL of them, not just the first u32 (a u8 written before the u32

@@ -24,11 +24,13 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..common import faults
 from ..common.exceptions import (
     CoordinatedAbortError,
+    FrameCorruptError,
     HorovodInternalError,
     PeerGoneError,
 )
@@ -39,6 +41,13 @@ log = get_logger("horovod_tpu.transport.tcp")
 
 _HELLO = struct.pack("<I", 0x48564D54)  # "HVMT"
 _LEN = struct.Struct("<Q")
+# Wire CRC field (HOROVOD_WIRE_CRC, default on): crc32(payload) follows the
+# length word, so the full frame header is <Q len|flags><I crc32>.  Control
+# frames carry it too — one header layout, no per-frame-kind branches.  The
+# CRC is CORRUPTION detection, not authentication (docs/security.md); a
+# mismatch is unrecoverable by design because positional framing after a
+# bad frame cannot be trusted (see FrameCorruptError).
+_CRC = struct.Struct("<I")
 # Top bit of the 8-byte length header marks a CONTROL frame (coordinated
 # abort).  In-band marking keeps control delivery ordered with data on the
 # same socket while staying unambiguous against arbitrary payload bytes —
@@ -48,6 +57,14 @@ _CTRL_FLAG = 1 << 63
 # progress deadline.  Bounds abort-propagation latency for threads blocked
 # on a DIFFERENT peer's socket than the one the abort arrived on.
 _ABORT_POLL_SECS = 0.25
+# Sanity cap on a frame's claimed payload size.  The length word itself is
+# not CRC-covered, and a flipped HIGH byte claims terabytes: recv would
+# allocate that buffer BEFORE any CRC or deadline could catch it
+# (MemoryError or the OOM killer, not a coordinated abort).  Real frames
+# are bounded by the fusion buffer (64 MB default) plus allgather fan-in —
+# orders of magnitude under this cap — so an oversized claim is treated
+# exactly like a CRC mismatch: poisoned stream, coordinated abort.
+_MAX_FRAME_BYTES = 1 << 32  # 4 GiB
 
 
 class _ProgressStall(Exception):
@@ -80,7 +97,8 @@ def _wait_writable(sock: socket.socket, timeout: float) -> bool:
 
 
 class _Peer:
-    __slots__ = ("sock", "send_lock", "recv_lock", "dead", "ever_received")
+    __slots__ = ("sock", "send_lock", "recv_lock", "dead", "ever_received",
+                 "frames_in")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -103,6 +121,9 @@ class _Peer:
         # "gone" is a judgment about a peer that WAS participating and
         # stopped.
         self.ever_received = False
+        # Completed frames received from this peer — diagnostic context
+        # for FrameCorruptError ("which frame in the stream went bad").
+        self.frames_in = 0
 
 
 class TcpMesh:
@@ -133,6 +154,10 @@ class TcpMesh:
             env_mod.HOROVOD_TCP_PROGRESS_DEADLINE,
             env_mod.DEFAULT_TCP_PROGRESS_DEADLINE_SECS) \
             if progress_deadline is None else progress_deadline
+        # Wire CRC (default on): sender stamps crc32(payload) into the
+        # frame header, receiver verifies before handing bytes up.  All
+        # ranks must agree (env-propagated like every other knob).
+        self.wire_crc = env_mod.get_bool(env_mod.HOROVOD_WIRE_CRC, True)
         # Mesh-wide abort state: (epoch, origin_rank, reason) once any link
         # delivered (or this rank broadcast) a coordinated abort.  Blocked
         # recvs observe it within _ABORT_POLL_SECS regardless of which
@@ -423,11 +448,27 @@ class TcpMesh:
         with p.send_lock:
             self._check_alive(p, peer)
             try:
-                if faults.ACTIVE and faults.inject(
-                        "tcp.send", rank=self.rank, peer=peer):
-                    return  # injected frame drop
-                self._send_bounded(p, _LEN.pack(len(payload)))
-                self._send_bounded(p, payload)
+                wire = payload
+                if faults.ACTIVE:
+                    verdict = faults.inject(
+                        "tcp.send", rank=self.rank, peer=peer,
+                        payload=payload)
+                    if verdict is True:
+                        return  # injected frame drop
+                    if isinstance(verdict, faults.SendMutation):
+                        # truncate: the frame is self-consistent (header
+                        # and CRC computed over the SHORT payload) — an
+                        # application-level misframe for the parse layer.
+                        # corrupt: wire_flips apply AFTER the CRC is
+                        # computed — in-flight corruption for the CRC
+                        # layer.
+                        payload = verdict.payload
+                        wire = verdict.wire_bytes()
+                header = _LEN.pack(len(payload))
+                if self.wire_crc:
+                    header += _CRC.pack(zlib.crc32(payload) & 0xFFFFFFFF)
+                self._send_bounded(p, header)
+                self._send_bounded(p, wire)
             except _ProgressStall as e:
                 self._mark_dead(p, str(e))
                 raise PeerGoneError(peer, str(e)) from None
@@ -476,11 +517,28 @@ class TcpMesh:
                     faults.inject("tcp.recv", rank=self.rank, peer=peer)
                 while True:
                     n = _LEN.unpack(self._recv_bounded(p, _LEN.size))[0]
+                    size = n & ~_CTRL_FLAG
+                    if size > _MAX_FRAME_BYTES:
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"frame header from rank {peer} claims "
+                            f"{size} bytes (cap {_MAX_FRAME_BYTES}): "
+                            "corrupted length word; aborting before "
+                            "allocating it"))
+                    crc = _CRC.unpack(self._recv_bounded(p, _CRC.size))[0] \
+                        if self.wire_crc else None
+                    payload = self._recv_bounded(p, size)
+                    p.frames_in += 1
+                    if crc is not None:
+                        got = zlib.crc32(payload) & 0xFFFFFFFF
+                        if got != crc:
+                            self._poison_stream(
+                                p, peer,
+                                FrameCorruptError(peer, p.frames_in,
+                                                  crc, got))
                     if n & _CTRL_FLAG:
-                        ctrl = self._recv_bounded(p, n & ~_CTRL_FLAG)
-                        self._handle_control(ctrl, peer)
+                        self._handle_control(payload, peer)
                         continue  # stale control frame: keep reading
-                    return self._recv_bounded(p, n)
+                    return payload
             except _ProgressStall as e:
                 self._mark_dead(p, str(e))
                 raise PeerGoneError(peer, str(e)) from None
@@ -526,6 +584,21 @@ class TcpMesh:
             elif deadline is not None:
                 deadline = time.monotonic() + budget
         return bytes(buf)
+
+    def _poison_stream(self, p: _Peer, peer: int,
+                       err: HorovodInternalError) -> None:
+        """The stream from ``peer`` is poisoned (wire-CRC mismatch, or a
+        length word claiming an absurd size).
+
+        Resync is impossible by design — the framing after a corrupt
+        frame cannot be trusted, so reading on would turn one bad byte
+        into positional desync (the PR 2 failure mode: survivors reading
+        negotiation bytes as tensor data).  Mark the peer dead, broadcast
+        the coordinated abort so every rank tears down at a frame
+        boundary, and let the mesh epoch (elastic plane) recover."""
+        self._mark_dead(p, str(err))
+        self.send_abort(str(err))
+        raise err
 
     def _handle_control(self, payload: bytes, peer: int) -> None:
         """Returns normally only for STALE control frames (discard)."""
@@ -573,10 +646,13 @@ class TcpMesh:
                 continue  # a wedged send holds the lock; skip this link
             try:
                 p.sock.settimeout(5.0)
+                header = _LEN.pack(len(payload) | _CTRL_FLAG)
+                if self.wire_crc:
+                    header += _CRC.pack(zlib.crc32(payload) & 0xFFFFFFFF)
                 # hvdlint: disable=HVD001 -- bounded by the settimeout(5.0)
                 # above; the teardown path must push the abort even though
                 # the non-blocking poll loops are already torn down.
-                p.sock.sendall(_LEN.pack(len(payload) | _CTRL_FLAG))
+                p.sock.sendall(header)
                 p.sock.sendall(payload)  # hvdlint: disable=HVD001 -- same 5s socket timeout bounds this write
             except OSError as e:
                 self._mark_dead(p, f"abort send failed: {e}")

@@ -1,0 +1,103 @@
+"""Self-healing checkpoint layer: atomic publish, CRC manifests, rotation.
+
+These are the rank-0-LOCAL primitives (no collectives), tested in-process;
+the distributed flavors (rank-0-writes + broadcast, kill-mid-save chaos)
+live in ``test_framework_api.py`` and ``test_fault_injection.py``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.frameworks.jax import checkpoint as ck
+
+pytestmark = pytest.mark.smoke
+
+
+def _state(step: int):
+    return {"w": np.arange(4, dtype=np.float32) * step,
+            "step": np.asarray(step)}
+
+
+def _corrupt_one_payload_byte(snap: str) -> str:
+    """Flip one byte in the largest payload file; returns the file."""
+    victim, size = None, -1
+    for dirpath, _, filenames in os.walk(snap):
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            if os.path.getsize(full) > size:
+                victim, size = full, os.path.getsize(full)
+    with open(victim, "rb") as f:
+        raw = bytearray(f.read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(victim, "wb") as f:
+        f.write(bytes(raw))
+    return victim
+
+
+def test_publish_writes_manifest_with_crc_and_step(tmp_path):
+    snap = str(tmp_path / "snap")
+    manifest = ck._publish_snapshot(snap, _state(7))
+    assert os.path.isdir(snap)
+    with open(ck._manifest_path(snap)) as f:
+        on_disk = json.load(f)
+    assert on_disk == manifest
+    assert on_disk["step"] == 7          # harvested from the state tree
+    assert on_disk["files"] > 0
+    crc, _, nfiles = ck._payload_crc(snap)
+    assert (crc, nfiles) == (on_disk["crc32"], on_disk["files"])
+    assert ck.snapshot_valid(snap) == (True, "ok")
+    # no temp litter left behind
+    assert not [n for n in os.listdir(tmp_path) if ".tmp-" in n]
+
+
+def test_snapshot_invalid_without_manifest(tmp_path):
+    snap = str(tmp_path / "snap")
+    ck._publish_snapshot(snap, _state(1))
+    os.remove(ck._manifest_path(snap))
+    ok, reason = ck.snapshot_valid(snap)
+    assert not ok and "no manifest" in reason
+
+
+def test_snapshot_invalid_on_payload_corruption(tmp_path):
+    snap = str(tmp_path / "snap")
+    ck._publish_snapshot(snap, _state(1))
+    _corrupt_one_payload_byte(snap)
+    ok, reason = ck.snapshot_valid(snap)
+    assert not ok and "CRC mismatch" in reason
+
+
+def test_snapshot_invalid_on_garbage_manifest(tmp_path):
+    snap = str(tmp_path / "snap")
+    ck._publish_snapshot(snap, _state(1))
+    with open(ck._manifest_path(snap), "w") as f:
+        f.write("{not json")
+    ok, reason = ck.snapshot_valid(snap)
+    assert not ok and "unreadable" in reason
+
+
+def test_publish_overwrite_replaces_and_revalidates(tmp_path):
+    snap = str(tmp_path / "snap")
+    ck._publish_snapshot(snap, _state(1))
+    ck._publish_snapshot(snap, _state(2))
+    assert ck.snapshot_valid(snap) == (True, "ok")
+    out = ck._restore_payload(snap, None)
+    assert int(out["step"]) == 2
+    # the move-aside overwrite protocol cleans up after itself
+    litter = [n for n in os.listdir(tmp_path)
+              if ".old-" in n or ".tmp-" in n]
+    assert not litter, litter
+
+
+def test_list_snapshots_orders_and_filters(tmp_path):
+    base = str(tmp_path / "run")
+    for seq in (1, 3, 2):
+        ck._publish_snapshot(f"{base}.{seq:08d}", _state(seq))
+    # litter that must NOT be listed: manifests, temp dirs, other names
+    os.makedirs(f"{base}.00000009.tmp-123")
+    os.makedirs(str(tmp_path / "unrelated.00000004"))
+    snaps = ck._list_snapshots(base)
+    assert [seq for seq, _ in snaps] == [3, 2, 1]
+    assert all(p.startswith(base + ".") for _, p in snaps)

@@ -11,6 +11,7 @@ Spec grammar and site list: ``docs/fault_injection.md`` /
 """
 
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -55,10 +56,13 @@ class TestFaultSpec:
                     "tcp.send:frobnicate",
                     "tcp.send:nth=0:action=raise",
                     "tcp.send:nth=1:after=2:action=raise",
-                    # drop is send-only: anywhere else it would silently
-                    # inject nothing
+                    # payload actions are send-only: anywhere else they
+                    # would silently inject nothing
                     "tcp.recv:action=drop",
-                    "dispatch.collective:action=drop"]:
+                    "dispatch.collective:action=drop",
+                    "tcp.recv:action=corrupt",
+                    "rendezvous.get:action=truncate,3",
+                    "ckpt.save:action=corrupt,2"]:
             with pytest.raises(ValueError):
                 faults.configure(bad)
 
@@ -252,6 +256,87 @@ def test_rendezvous_failure_fails_init_fast():
         assert "WORKER_OK" not in out  # init must have failed
 
 
+@pytest.mark.timeout(150)
+def test_corrupt_frame_np2_coordinated_abort():
+    """A single in-flight byte flip must abort BOTH ranks with the wire-CRC
+    diagnosis within one poll quantum — never desync into reading
+    negotiation bytes as tensor data (the PR 2 failure this plane
+    closes)."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=6:action=corrupt,1"})
+    # rank 0 detects (its recv fails CRC); rank 1 hears the abort naming
+    # the CRC failure — or observes the torn socket, both clean errors
+    assert "SURVIVOR_ABORT 0" in outs[0], outs[0]
+    assert "wire CRC" in outs[0], outs[0]
+    assert "SURVIVOR_ABORT 1" in outs[1], outs[1]
+
+
+@pytest.mark.timeout(150)
+def test_truncated_frame_np2_typed_abort():
+    """A misframed (short) application frame passes the wire CRC by
+    construction and must be caught by the defensive parse layer as a
+    typed error — both ranks abort, nobody hangs or struct-errors."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=6:action=truncate,4"})
+    for r in range(2):
+        assert f"SURVIVOR_ABORT {r}" in outs[r], (r, outs[r])
+        assert "struct.error" not in outs[r], (r, outs[r])
+
+
+_KILL_MID_SAVE_BODY = """
+import horovod_tpu.frameworks.jax.checkpoint as ckpt
+base = BASE_DIR + "/run"
+for step in (1, 2, 3):
+    ckpt.save_rotating(
+        base, {"w": np.full(4, float(step), np.float32), "step": step},
+        keep=5, step=step)
+    print("SAVED", step, flush=True)
+print("SURVIVED_ALL_SAVES", flush=True)
+"""
+
+_RESTORE_AFTER_KILL_BODY = """
+import logging, sys
+import horovod_tpu.frameworks.jax.checkpoint as ckpt
+_log = logging.getLogger("horovod_tpu.frameworks.jax.checkpoint")
+_log.addHandler(logging.StreamHandler(sys.stdout))
+_log.setLevel(logging.INFO)
+state = ckpt.restore_latest(
+    BASE_DIR + "/run",
+    like={"w": np.zeros(4, np.float32), "step": 0})
+assert int(state["step"]) == 2, state
+assert np.allclose(np.asarray(state["w"]), 2.0), state
+print("RESTORED_PREVIOUS_VALID", rank, flush=True)
+"""
+
+
+@pytest.mark.timeout(150)
+def test_kill_mid_ckpt_save_restore_latest_skips_half_written(tmp_path):
+    """A rank hard-dying inside ``ckpt.save`` (between payload publish
+    and manifest commit — the ``ckpt.save`` site's window) leaves a
+    half-written newest snapshot; ``restore_latest`` must detect it,
+    LOG the skip, and land on the last intact snapshot."""
+    prelude = f"BASE_DIR = {str(tmp_path)!r}\n"
+    outs = run_distributed(
+        1, prelude + _KILL_MID_SAVE_BODY, timeout=120,
+        expect_failure=True, retries=0,
+        extra_env={"HOROVOD_FAULT_SPEC": "ckpt.save:nth=3:action=exit,9"})
+    assert "SAVED 2" in outs[0], outs[0]
+    assert "SURVIVED_ALL_SAVES" not in outs[0], outs[0]
+
+    outs = run_distributed(1, prelude + _RESTORE_AFTER_KILL_BODY,
+                           timeout=120, retries=0)
+    assert "RESTORED_PREVIOUS_VALID 0" in outs[0], outs[0]
+    assert "skipping snapshot" in outs[0], outs[0]
+    assert "00000003" in outs[0], outs[0]   # names WHAT it skipped
+    assert "no manifest" in outs[0], outs[0]  # ...and why
+
+
 _ELASTIC_CHAOS_TRAIN = """
 import os, time
 import numpy as np
@@ -279,6 +364,82 @@ train(state)
 print("ELASTIC_DONE", hvd.rank(), flush=True)
 hvd.shutdown()
 """
+
+
+_ELASTIC_CORRUPTION_TRAIN = """
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0, params=np.zeros(4, np.float32))
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 15:
+        grad = hvd.allreduce(
+            np.full(4, float(state.batch + 1), np.float32),
+            op=hvd.Sum, name="g")
+        state.params = state.params + np.asarray(grad)
+        state.batch += 1
+        state.commit()
+
+train(state)
+print("FINAL_PARAMS r%d %s" % (
+    hvd.rank(), np.asarray(state.params).tobytes().hex()), flush=True)
+print("ELASTIC_DONE", hvd.rank(), flush=True)
+hvd.shutdown()
+"""
+
+
+def _run_elastic_corruption_job(tmp_path, fault_spec):
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+    disc.chmod(0o755)
+    train = tmp_path / f"train_{'fault' if fault_spec else 'clean'}.py"
+    train.write_text(_ELASTIC_CORRUPTION_TRAIN)
+
+    env = os.environ.copy()
+    env.update(_FAST_DEADLINE)
+    env["HOROVOD_LOG_LEVEL"] = "info"  # driver logs the reset trigger
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    if fault_spec:
+        env["HOROVOD_FAULT_SPEC"] = fault_spec
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "2",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        capture_output=True, timeout=240)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    params = dict(re.findall(r"FINAL_PARAMS r(\d+) ([0-9a-f]+)",
+                             proc.stdout))
+    assert set(params) == {"0", "1"}, proc.stdout[-2000:]
+    assert params["0"] == params["1"], "ranks diverged"
+    return params["0"], proc
+
+
+@pytest.mark.timeout(600)
+def test_elastic_recovers_from_frame_corruption_bit_identical(tmp_path):
+    """The integrity plane end to end: an in-flight byte flip mid-training
+    aborts both (still-alive) ranks, the worker-posted reset request makes
+    the driver advance an epoch, both workers roll back to their last
+    commit and re-rendezvous — and the finished run's params are
+    BIT-identical to a no-fault run of the same script."""
+    clean, _ = _run_elastic_corruption_job(tmp_path, None)
+    faulted, proc = _run_elastic_corruption_job(
+        tmp_path, "tcp.send:rank=1:nth=25:action=corrupt,1")
+    assert faulted == clean, "recovery did not converge to the no-fault run"
+    # the fault actually fired and recovered through the epoch plane: the
+    # driver logged the worker's reset request naming the CRC failure
+    assert "reset_requests" in proc.stderr and "advancing epoch" \
+        in proc.stderr, proc.stderr[-3000:]
+    assert "wire CRC" in proc.stderr, proc.stderr[-3000:]
 
 
 @pytest.mark.timeout(300)

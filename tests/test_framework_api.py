@@ -131,3 +131,61 @@ print("CKPT_OK", rank, flush=True)
 """, timeout=240)
     for r, o in enumerate(out):
         assert f"CKPT_OK {r}" in o
+
+
+def test_checkpoint_rotating_self_healing_np2(tmp_path):
+    """The integrity-plane checkpoint contract, distributed: a missing
+    checkpoint raises typed CheckpointNotFoundError on EVERY rank (no
+    exists()+restore() TOCTOU), rotation prunes to ``keep``, and a
+    corrupted newest snapshot falls back to the previous valid one on
+    every rank."""
+    out = run_distributed(2, f"""
+import os
+import horovod_tpu.frameworks.jax.checkpoint as ckpt
+from horovod_tpu.common.exceptions import CheckpointNotFoundError
+
+base = {str(tmp_path)!r} + "/run"
+
+# 1. nothing there yet: typed not-found on every rank, not a hang/TOCTOU
+try:
+    ckpt.restore_latest(base)
+    print("MISSED_NOT_FOUND", rank, flush=True)
+except CheckpointNotFoundError:
+    print("NOT_FOUND_OK", rank, flush=True)
+try:
+    ckpt.restore(base + ".direct")
+except CheckpointNotFoundError:
+    print("RESTORE_NOT_FOUND_OK", rank, flush=True)
+
+# 2. three rotating saves with keep=2: oldest pruned
+like = {{"w": np.zeros(4, np.float32), "step": np.asarray(0)}}
+for step in (1, 2, 3):
+    ckpt.save_rotating(
+        base, {{"w": np.full(4, float(step), np.float32),
+               "step": np.asarray(step)}}, keep=2, step=step)
+if rank == 0:
+    snaps = ckpt._list_snapshots(os.path.abspath(base))
+    assert [s for s, _ in snaps] == [3, 2], snaps
+
+# 3. corrupt the NEWEST on disk (rank 0 only touches storage); every
+#    rank still restores the previous valid snapshot
+if rank == 0:
+    snap = ckpt._list_snapshots(os.path.abspath(base))[0][1]
+    victim = None
+    for dp, _, fn in os.walk(snap):
+        for f in fn:
+            p = os.path.join(dp, f)
+            if victim is None or os.path.getsize(p) > os.path.getsize(victim):
+                victim = p
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(raw))
+state = ckpt.restore_latest(base, like=like)
+assert int(state["step"]) == 2, state
+assert np.allclose(np.asarray(state["w"]), 2.0), state
+print("HEALED_OK", rank, flush=True)
+""", timeout=240)
+    for r, o in enumerate(out):
+        for mark in ("NOT_FOUND_OK", "RESTORE_NOT_FOUND_OK", "HEALED_OK"):
+            assert f"{mark} {r}" in o, (mark, r, o)
+        assert "MISSED_NOT_FOUND" not in o

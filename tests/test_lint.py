@@ -418,6 +418,82 @@ def test_hvd005_clean_and_scoped():
     assert run(HVD005_DUPLICATE) == []
 
 
+# -- extended header layout (integrity plane): transport/tcp.py contract --
+
+TCP_PATH = os.path.join(PKG, "transport", "tcp.py")
+
+HVD005_TCP_CLEAN = """
+    import struct
+    _LEN = struct.Struct("<Q")
+    _CRC = struct.Struct("<I")
+    _CTRL_FLAG = 1 << 63
+"""
+
+HVD005_TCP_WRONG_LEN = """
+    import struct
+    _LEN = struct.Struct("<I")
+    _CRC = struct.Struct("<I")
+    _CTRL_FLAG = 1 << 63
+"""
+
+HVD005_TCP_NO_CRC = """
+    import struct
+    _LEN = struct.Struct("<Q")
+    _CTRL_FLAG = 1 << 63
+"""
+
+HVD005_TCP_NO_CTRL = """
+    import struct
+    _LEN = struct.Struct("<Q")
+    _CRC = struct.Struct("<I")
+"""
+
+HVD005_MESSAGES_CRC = """
+    import zlib
+    A_MAGIC = 0x11111111
+    class F:
+        def to_bytes(self):
+            w = Writer()
+            w.u32(A_MAGIC)
+            w.u32(zlib.crc32(bytes(w.buf)))
+            return w.getvalue()
+"""
+
+
+def test_hvd005_transport_header_clean():
+    assert run(HVD005_TCP_CLEAN, path=TCP_PATH) == []
+    # The 1 << 63 literal is RESERVED for tcp.py — owning it there is
+    # the contract, not a violation.
+
+
+def test_hvd005_transport_wrong_len_format():
+    vs = run(HVD005_TCP_WRONG_LEN, path=TCP_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "_LEN" in vs[0].message and "'<Q'" in vs[0].message
+
+
+def test_hvd005_transport_missing_crc_struct():
+    vs = run(HVD005_TCP_NO_CRC, path=TCP_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "_CRC" in vs[0].message
+
+
+def test_hvd005_transport_missing_ctrl_flag():
+    vs = run(HVD005_TCP_NO_CTRL, path=TCP_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "_CTRL_FLAG" in vs[0].message
+
+
+def test_hvd005_messages_must_not_crc():
+    # The CRC envelope is the transport's; a second checksum computed in
+    # messages.py would drift from it (two integrity layers, no owner).
+    vs = run(HVD005_MESSAGES_CRC, path=MESSAGES_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "crc" in vs[0].message.lower()
+    # ...and crc32 outside the scoped files is not this rule's business.
+    assert run(HVD005_MESSAGES_CRC) == []
+
+
 # ---------------------------------------------------------------------------
 # HVD006 — anonymous threads
 # ---------------------------------------------------------------------------

@@ -91,6 +91,125 @@ def test_abort_frame_roundtrip():
         AbortFrame.from_bytes(RequestList().to_bytes())
 
 
+def test_abort_reason_bounded_at_construction():
+    """A giant traceback in the reason must not bloat the control frame:
+    ≤ 512 UTF-8 bytes from construction on, truncation marked, and the
+    bounded frame still round-trips."""
+    from horovod_tpu.core.messages import (
+        MAX_ABORT_REASON_BYTES,
+        AbortFrame,
+    )
+
+    frame = AbortFrame(epoch=1, origin_rank=0, reason="x" * 10_000)
+    assert len(frame.reason.encode("utf-8")) <= MAX_ABORT_REASON_BYTES
+    assert frame.reason.endswith("…[truncated]")
+    out = AbortFrame.from_bytes(frame.to_bytes())
+    assert out.reason == frame.reason
+    # multi-byte characters at the cut never split into mojibake
+    multi = AbortFrame(reason="é" * 600)
+    assert len(multi.reason.encode("utf-8")) <= MAX_ABORT_REASON_BYTES
+    multi.reason.encode("utf-8").decode("utf-8")  # still valid UTF-8
+    # short reasons pass through untouched
+    assert AbortFrame(reason="peer died").reason == "peer died"
+
+
+def test_bad_magic_reports_got_expected_and_hexdump():
+    wire = ResponseList().to_bytes()
+    with pytest.raises(ValueError) as exc:
+        # a MaskFrame parser fed a ResponseList frame
+        from horovod_tpu.core.messages import MaskFrame
+
+        MaskFrame.from_bytes(wire)
+    msg = str(exc.value)
+    assert "got 0x48564454" in msg          # WIRE_MAGIC it found
+    assert "expected 0x4B53414D" in msg     # MASK_MAGIC it wanted
+    assert wire[:16].hex(" ") in msg        # the head hexdump
+
+
+# ---------------------------------------------------------------------------
+# single-byte-flip / truncation fuzz: the two-layer integrity contract
+# ---------------------------------------------------------------------------
+
+def _exemplar_frames():
+    """One realistic instance of EVERY frame type that crosses the wire."""
+    from horovod_tpu.core.messages import AbortFrame, MaskFrame
+
+    req = Request(
+        request_rank=3, request_type=RequestType.ALLGATHER,
+        tensor_name="layer0/kernel.grad", tensor_type=DataType.BFLOAT16,
+        tensor_shape=[128, 784], root_rank=1, device=0, group_id=2,
+        prescale_factor=0.5, postscale_factor=0.25, splits=[1, 2, 3])
+    resp = Response(
+        response_type=ResponseType.ALLREDUCE, tensor_names=["a", "b"],
+        tensor_type=DataType.FLOAT32, tensor_sizes=[5, 9],
+        error_message="err", devices=[0, 1], prescale_factor=2.0,
+        postscale_factor=0.125, last_joined_rank=1)
+    return [
+        ("RequestList", RequestList,
+         RequestList(requests=[req, Request(tensor_name="b")],
+                     shutdown=True, cache_hits=[1, 5],
+                     cache_mask=b"\x2a\x01")),
+        ("ResponseList", ResponseList,
+         ResponseList(responses=[resp], shutdown=False,
+                      cache_assignments=[(7, req)], evicted_bits=[2],
+                      tuned_params=(64 << 20, 1.5))),
+        ("MaskFrame", MaskFrame, MaskFrame(mask=b"\xff\x10", shutdown=True)),
+        ("AbortFrame", AbortFrame,
+         AbortFrame(epoch=4, origin_rank=1, reason="peer rank 2 is gone")),
+    ]
+
+
+def test_every_frame_type_roundtrips():
+    for name, cls, frame in _exemplar_frames():
+        assert cls.from_bytes(frame.to_bytes()) == frame, name
+
+
+def test_single_byte_flip_never_silently_misparses():
+    """The integrity contract, exhaustively: for EVERY byte position and
+    a spread of XOR masks, a flipped frame either (a) raises a TYPED
+    parse error — never a raw struct.error — or (b) parses into a
+    DIFFERENT value, which the wire CRC catches (crc32 of the flipped
+    bytes always differs for a single-byte flip).  A flip that parsed
+    back EQUAL to the original would be a silent misparse past both
+    layers — the bug class this plane exists to kill."""
+    import struct as struct_mod
+    import zlib
+
+    from horovod_tpu.common.exceptions import TruncatedFrameError
+
+    for name, cls, frame in _exemplar_frames():
+        wire = frame.to_bytes()
+        base_crc = zlib.crc32(wire)
+        for pos in range(len(wire)):
+            for mask in (0x01, 0x80, 0xFF):
+                flipped = wire[:pos] + bytes([wire[pos] ^ mask]) \
+                    + wire[pos + 1:]
+                try:
+                    out = cls.from_bytes(flipped)
+                except (TruncatedFrameError, ValueError, OverflowError):
+                    continue  # typed parse-layer rejection
+                except struct_mod.error:  # pragma: no cover
+                    pytest.fail(f"{name}: raw struct.error leaked at "
+                                f"byte {pos} mask 0x{mask:02X}")
+                assert out != frame or zlib.crc32(flipped) != base_crc, \
+                    f"{name}: silent misparse at byte {pos} mask {mask:#x}"
+                # CRC32 detects every single-byte flip, so layer 2 always
+                # catches what the parser accepted:
+                assert zlib.crc32(flipped) != base_crc
+
+
+def test_truncated_prefix_always_typed_error():
+    """Every strict prefix of every frame fails TYPED (truncation is what
+    an interrupted sender or an injected truncate fault produces)."""
+    from horovod_tpu.common.exceptions import TruncatedFrameError
+
+    for name, cls, frame in _exemplar_frames():
+        wire = frame.to_bytes()
+        for cut in range(len(wire)):
+            with pytest.raises((TruncatedFrameError, ValueError)):
+                cls.from_bytes(wire[:cut])
+
+
 @pytest.mark.parametrize("np_dtype", [
     np.uint8, np.int8, np.int32, np.int64, np.float16, np.float32,
     np.float64, np.bool_,

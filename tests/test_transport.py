@@ -309,9 +309,12 @@ def test_recv_progress_resets_deadline():
         # 1.5 s margin keeps scheduler hiccups on a loaded box from
         # tripping it (this in-process test has no retry gate)
         import struct as struct_mod
+        import zlib as zlib_mod
 
         sock = meshes[0]._peers[1].sock
-        frame = struct_mod.pack("<Q", len(payload)) + payload
+        frame = struct_mod.pack("<Q", len(payload)) \
+            + struct_mod.pack("<I", zlib_mod.crc32(payload) & 0xFFFFFFFF) \
+            + payload
         for off in range(0, len(frame), len(frame) // 4):
             sock.sendall(frame[off:off + len(frame) // 4])
             time_mod.sleep(0.5)
@@ -381,6 +384,128 @@ def test_stale_epoch_abort_discarded():
         meshes[0]._abort = None  # broadcast marks the sender; clear to reuse
         meshes[0].send(1, b"fresh")
         assert meshes[1].recv(0) == b"fresh"
+    finally:
+        for m in meshes:
+            m.close()
+
+
+def test_wire_crc_catches_inflight_corruption():
+    """An injected in-flight byte flip (``action=corrupt``: the sender's
+    CRC covers the ORIGINAL payload) must surface as FrameCorruptError on
+    the receiver — naming the peer, frame index, and both CRCs — and
+    broadcast a coordinated abort back across the mesh."""
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import (
+        CoordinatedAbortError,
+        FrameCorruptError,
+    )
+
+    meshes = _mesh_pair()
+    try:
+        meshes[0].send(1, b"clean")  # frame 1: intact
+        assert meshes[1].recv(0) == b"clean"
+        faults.configure("tcp.send:rank=0:nth=1:action=corrupt,2")
+        meshes[0].send(1, b"poisoned-payload")
+        with pytest.raises(FrameCorruptError) as exc:
+            meshes[1].recv(0)
+        err = exc.value
+        assert err.peer == 0 and err.frame_index == 2
+        assert err.expected_crc != err.got_crc
+        assert "resync is impossible" in str(err)
+        # the detector's abort reached the corrupting side
+        with pytest.raises(CoordinatedAbortError, match="wire CRC"):
+            meshes[0].recv(1)
+        # and the detector itself fails fast now (peer marked dead)
+        from horovod_tpu.common.exceptions import HorovodInternalError
+
+        with pytest.raises(HorovodInternalError):
+            meshes[1].recv(0)
+    finally:
+        faults.reset()
+        for m in meshes:
+            m.close()
+
+
+def test_corrupt_injection_is_deterministic():
+    """The same spec must flip the same bytes with the same masks — the
+    reproducibility contract every other fault action keeps."""
+    from horovod_tpu.common import faults
+
+    outs = []
+    for _ in range(2):
+        faults.configure("tcp.send:nth=1:action=corrupt,3")
+        v = faults.inject("tcp.send", rank=0, payload=b"x" * 64)
+        outs.append((v.payload, v.wire_bytes()))
+        faults.reset()
+    assert outs[0] == outs[1]
+    assert outs[0][0] != outs[0][1], "corrupt flipped nothing"
+
+
+def test_truncate_fault_passes_crc_parse_layer_catches():
+    """``action=truncate`` shortens the payload BEFORE framing: header
+    and CRC agree with the short bytes, so the transport hands them up
+    intact — and the defensive parse layer is what catches the damage
+    (typed TruncatedFrameError, never a raw struct.error)."""
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import TruncatedFrameError
+    from horovod_tpu.core.messages import Request, RequestList
+
+    wire = RequestList(
+        requests=[Request(tensor_name="layer0/kernel.grad",
+                          tensor_shape=[128, 784])]).to_bytes()
+    meshes = _mesh_pair()
+    try:
+        faults.configure("tcp.send:rank=0:nth=1:action=truncate,5")
+        meshes[0].send(1, wire)
+        got = meshes[1].recv(0)  # transport-level: a clean short frame
+        assert got == wire[:-5]
+        with pytest.raises(TruncatedFrameError, match="truncated"):
+            RequestList.from_bytes(got)
+    finally:
+        faults.reset()
+        for m in meshes:
+            m.close()
+
+
+def test_corrupted_length_word_aborts_before_allocating():
+    """The length word is NOT CRC-covered: a flipped high byte claims
+    terabytes, and recv must treat it as a poisoned stream (coordinated
+    abort) BEFORE trying to allocate the claimed buffer — the failure
+    mode is MemoryError/OOM-kill otherwise, which no abort path survives."""
+    import struct as struct_mod
+
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    meshes = _mesh_pair()
+    try:
+        sock = meshes[0]._peers[1].sock
+        # hand-frame a header claiming 1 TiB (as a corrupted-in-flight
+        # length word would); CRC field and payload never matter — the
+        # cap must trip first
+        sock.sendall(struct_mod.pack("<Q", 1 << 40))
+        with pytest.raises(HorovodInternalError,
+                           match="corrupted length word") as exc:
+            meshes[1].recv(0)
+        assert "aborting before allocating" in str(exc.value)
+        # the abort reached the sending side too
+        from horovod_tpu.common.exceptions import CoordinatedAbortError
+
+        with pytest.raises(CoordinatedAbortError):
+            meshes[0].recv(1)
+    finally:
+        for m in meshes:
+            m.close()
+
+
+def test_wire_crc_disabled_by_knob(monkeypatch):
+    """HOROVOD_WIRE_CRC=0 falls back to the bare 8-byte header — frames
+    still deliver (both sides read the knob from the shared env)."""
+    monkeypatch.setenv("HOROVOD_WIRE_CRC", "0")
+    meshes = _mesh_pair()
+    try:
+        assert all(not m.wire_crc for m in meshes)
+        meshes[0].send(1, b"unverified")
+        assert meshes[1].recv(0) == b"unverified"
     finally:
         for m in meshes:
             m.close()
