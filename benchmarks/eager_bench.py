@@ -119,18 +119,20 @@ def main() -> None:
         params = apply_updates(params, updates)
         return loss
 
-    from horovod_tpu.core.timeline import phase_stats
+    from horovod_tpu.core.timeline import phase_stats, wire_stats
 
     for _ in range(warmup):
         loss = eager_step()
     float(loss)
     phase_stats.reset()  # profile the steady-state timed region only
+    wire_stats.reset()
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = eager_step()
     final_loss = float(loss)
     eager_dt = (time.perf_counter() - t0) / iters
     phase_breakdown = phase_stats.snapshot()
+    wire_counters = wire_stats.snapshot()
     assert np.isfinite(final_loss)
 
     # ---- wfbp flavor: forward+backward+allreduce+update, ONE program --
@@ -183,6 +185,10 @@ def main() -> None:
         # Where the eager step's overhead budget goes, per phase, over the
         # timed region (totals across all iters; mean per occurrence).
         result["phase_breakdown_ms"] = phase_breakdown
+        # Data-plane counters (core/timeline.py wire_stats): payload bytes
+        # the transport moved and heap materializations in the host data
+        # plane during the steady-state timed region.
+        result["wire_counters"] = wire_counters
     hvd.shutdown()
     line = json.dumps(result)
     print(line)

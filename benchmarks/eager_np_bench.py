@@ -132,14 +132,19 @@ def main() -> None:
         return run
 
     # -- eager: negotiate+fuse+collective every step --------------------
-    from horovod_tpu.core.timeline import phase_stats
+    from horovod_tpu.core.timeline import phase_stats, wire_stats
 
-    # phase_stats resets after warmup so the breakdown covers the
-    # steady-state (cache-warm) timed region only.
+    # phase_stats/wire_stats reset after warmup so the breakdown covers
+    # the steady-state (cache-warm) timed region only.
+    def _reset_stats():
+        phase_stats.reset()
+        wire_stats.reset()
+
     eager_dt = _bench(eager_flavor(DistributedOptimizer(tx)),
                       args.warmup, args.iters,
-                      after_warmup=phase_stats.reset)
+                      after_warmup=_reset_stats)
     phase_breakdown = phase_stats.snapshot()
+    wire_counters = wire_stats.snapshot()
 
     # -- eager overlap: WFBP microbatch pipeline (2 backwards/step) ------
     # n_calls=2 → one full accumulation window per run; per-backward time
@@ -206,6 +211,10 @@ def main() -> None:
     }
     if args.profile:
         result["phase_breakdown_ms"] = phase_breakdown
+        # Data-plane counters (core/timeline.py wire_stats): payload bytes
+        # the transport moved and heap materializations in the host data
+        # plane during the steady-state eager region.
+        result["wire_counters"] = wire_counters
     hvd.shutdown()
     if rank == 0:
         line = json.dumps(result)

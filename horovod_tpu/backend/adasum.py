@@ -127,12 +127,13 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
         distances 1..distance (reference SumAllreduceWithComm on the level's
         reduction communicator, adasum.h:368)."""
         rank = self.topo.rank
+        got = np.empty_like(triplets)  # tiny per-tensor metadata scratch
         j = 1
         while j <= distance:
             peer = rank ^ j
-            got = np.frombuffer(
-                self.mesh.sendrecv(peer, triplets.tobytes(), peer),
-                dtype=np.float64).reshape(triplets.shape)
+            self.mesh.sendrecv_into(
+                peer, cpu_ring._byte_view(np.ascontiguousarray(triplets)),
+                peer, cpu_ring._byte_view(got))
             triplets = triplets + got
             j <<= 1
         return triplets
@@ -184,14 +185,19 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
                 send_lo, send_hi, keep_lo, keep_hi = lo, mid, mid, hi
             else:
                 send_lo, send_hi, keep_lo, keep_hi = mid, hi, lo, mid
-            peer_half = np.frombuffer(
-                self.mesh.sendrecv(peer, buf[send_lo:send_hi].tobytes(), peer),
-                dtype=acc_dtype).copy()
             kept = buf[keep_lo:keep_hi]
-            if peer_half.size != kept.size:
-                raise HorovodInternalError(
-                    "Adasum exchange size mismatch "
-                    f"({peer_half.size} vs {kept.size})")
+            # Zero-copy exchange: our half goes out as a view, the peer's
+            # lands in persistent staging (recv_into enforces that the
+            # frame carries exactly kept.size elements — a mismatch
+            # poisons the stream instead of mis-combining).
+            stage = self.fusion_buffers.get(
+                acc_dtype, kept.size, key="adasum-stage") \
+                if self.fusion_buffers is not None \
+                else np.empty(kept.size, acc_dtype)
+            peer_half = stage[:kept.size]
+            self.mesh.sendrecv_into(
+                peer, cpu_ring._byte_view(buf[send_lo:send_hi]),
+                peer, cpu_ring._byte_view(peer_half))
             # Canonical orientation: `a` is the vector accumulated by the
             # lower subgroup (bit `distance` clear), `b` by the upper —
             # every rank in the reduction group agrees on which is which.
@@ -217,10 +223,11 @@ class AdasumAllreduce(cpu_ring.CollectiveOp):
                 other_lo, other_hi = lo - span, lo
             else:
                 other_lo, other_hi = hi, hi + span
-            peer_data = np.frombuffer(
-                self.mesh.sendrecv(peer, buf[lo:hi].tobytes(), peer),
-                dtype=acc_dtype)
-            buf[other_lo:other_hi] = peer_data
+            # Disjoint slices of `buf`: send our slice as a view while the
+            # peer's lands directly in its final position — no staging.
+            self.mesh.sendrecv_into(
+                peer, cpu_ring._byte_view(buf[lo:hi]),
+                peer, cpu_ring._byte_view(buf[other_lo:other_hi]))
             lo, hi = min(lo, other_lo), max(hi, other_hi)
 
         buf = buf[:real_n]

@@ -19,6 +19,15 @@ run the collective, scatter results back out.  Algorithms:
   root).
 - alltoall(v): pairwise exchange, N−1 rounds of offset sendrecv.
 
+Zero-copy, segment-pipelined (docs/data_plane.md): every ring step streams
+its chunk as ``HOROVOD_RING_SEGMENT_BYTES``-sized segments — segment k
+reduces in numpy while segment k+1 is on the wire — with sends framed
+straight from buffer views and receives landing in persistent
+``FusionBufferManager`` staging (or the output's final resting place).
+Steady-state ring steps perform ZERO heap materializations of payload
+bytes; the ``core/timeline.py`` ``wire_stats`` counters (``bytes_on_wire``,
+``heap_copies``) prove it, and the test suite asserts it.
+
 These run on numpy buffers and serve CPU deployments, multi-process tests,
 and as the cross-host fallback; the XLA backend (``backend/xla.py``) is the
 TPU data plane.
@@ -30,28 +39,36 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..common import env as env_mod
 from ..common.exceptions import HorovodInternalError
 from ..common.topology import ProcessTopology
 from ..core.messages import DataType, Response, ResponseType
 from ..core.tensor_queue import Status, TensorTableEntry
+from ..core.timeline import wire_stats
 from ..transport.tcp import TcpMesh
 
 
 class FusionBufferManager:
-    """Persistent per-dtype staging buffers (reference
+    """Persistent keyed staging arenas (reference
     ``fusion_buffer_manager.h``): one allocation reused across cycles
     instead of a fresh tens-of-MB concatenate-and-free per fused response
-    (VERDICT missing #6 — page-fault churn on every cycle)."""
+    (VERDICT missing #6 — page-fault churn on every cycle).
+
+    ``key`` separates concurrent roles sharing a dtype — the fusion
+    buffer proper (``"fusion"``, the default), the ring's receive staging
+    (``"ring-stage"``), the fused-allgather block arena (``"allgather"``)
+    — so a staged fuse and a staged recv never alias each other."""
 
     def __init__(self):
         self._bufs: dict = {}
 
-    def get(self, dtype: np.dtype, elems: int) -> np.ndarray:
+    def get(self, dtype: np.dtype, elems: int,
+            key: str = "fusion") -> np.ndarray:
         dtype = np.dtype(dtype)
-        buf = self._bufs.get(dtype)
+        buf = self._bufs.get((key, dtype))
         if buf is None or buf.size < elems:
             buf = np.empty(max(elems, 1), dtype=dtype)
-            self._bufs[dtype] = buf
+            self._bufs[(key, dtype)] = buf
         return buf[:elems]
 
 
@@ -86,17 +103,39 @@ def _accum_dtype(dtype: np.dtype) -> np.dtype:
     return dtype
 
 
+def _byte_view(arr: np.ndarray) -> memoryview:
+    """Flat byte view over a contiguous numpy array — what the zero-copy
+    transport sends from and receives into.  Goes through a uint8
+    reinterpret view because extension dtypes (ml_dtypes bfloat16) export
+    no PEP-3118 buffer format of their own, so ``memoryview(arr)`` would
+    raise on exactly the narrow-wire dtypes this path exists for.
+    Non-contiguous input raises (numpy refuses the view): the caller holds
+    a strided view it must materialize itself — copying silently here
+    would defeat the zero-copy contract."""
+    return memoryview(arr.view(np.uint8).reshape(-1))
+
+
 def fuse_entries(entries: List[TensorTableEntry], dtype: np.dtype,
                  fbm: Optional[FusionBufferManager] = None) -> np.ndarray:
     """MemcpyInFusionBuffer analog (``collective_operations.cc``).
 
     Never returns a view of an entry's tensor, so backends may mutate the
-    result freely without corrupting user input.  With ``fbm``, multi-entry
-    payloads stage into the persistent fusion buffer (the result then
-    ALIASES the manager's storage — callers must unfuse with ``copy=True``
-    before the next cycle reuses it)."""
+    result freely without corrupting user input — which makes exactly ONE
+    copy per entry the floor, and this performs exactly that:
+    ``astype(copy=True)`` BEFORE ``ravel`` materializes contiguous
+    ``dtype`` output in a single pass (the old ravel-then-astype order
+    double-copied non-contiguous tensors: ravel copied to flatten, astype
+    copied again).  With ``fbm``, multi-entry payloads stage into the
+    persistent fusion buffer (the result then ALIASES the manager's
+    storage — callers must unfuse with ``copy=True`` before the next
+    cycle reuses it)."""
     if len(entries) == 1:
-        return np.asarray(entries[0].tensor).ravel().astype(dtype, copy=True)
+        wire_stats.add("heap_copies")
+        # order="C" matters: astype's default order="K" would keep a
+        # Fortran-ordered input F-ordered and the ravel would copy AGAIN.
+        return np.asarray(entries[0].tensor).astype(
+            dtype, order="C", copy=True).ravel()
+    wire_stats.add("heap_copies", len(entries))
     if fbm is not None:
         total = sum(int(np.asarray(e.tensor).size) for e in entries)
         buf = fbm.get(dtype, total)
@@ -117,6 +156,8 @@ def unfuse_entries(buf: np.ndarray, entries: List[TensorTableEntry],
     ``copy=True`` materializes each output (required when ``buf`` is the
     persistent fusion buffer — a view would be silently overwritten by the
     next fused response)."""
+    if copy:
+        wire_stats.add("heap_copies", len(entries))
     offset = 0
     for e in entries:
         n = int(np.asarray(e.tensor).size)
@@ -163,37 +204,108 @@ def _chunk_bounds(n: int, parts: int) -> np.ndarray:
     return np.cumsum([0] + counts)
 
 
+def _segment_elems(dtype: np.dtype) -> int:
+    """Pipeline segment size in ELEMENTS (≥ 1), from the shared
+    ``HOROVOD_RING_SEGMENT_BYTES`` knob.  Every rank derives the same
+    value (launcher-propagated env), so both endpoints of every link
+    frame identically; a byte count below one element clamps to one, a
+    count at or above the chunk size degrades to the unpipelined
+    single-frame step."""
+    seg_bytes = env_mod.get_int(env_mod.HOROVOD_RING_SEGMENT_BYTES,
+                                env_mod.DEFAULT_RING_SEGMENT_BYTES)
+    return max(1, seg_bytes // max(1, np.dtype(dtype).itemsize))
+
+
+def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
+                   send_arr: np.ndarray, recv_arr: np.ndarray,
+                   reduce_to: Optional[np.ndarray] = None,
+                   wide: Optional[np.dtype] = None) -> None:
+    """One zero-copy, segment-pipelined ring step — the primitive every
+    host collective builds on.
+
+    Streams ``send_arr`` to ``nxt`` in segments while receiving
+    ``recv_arr.size`` elements from ``prv`` directly into ``recv_arr``'s
+    segments; when ``reduce_to`` is given, each landed segment is folded
+    into it (wide-precision add) while the NEXT segment is still on the
+    wire::
+
+        post recv k → send k → wait k-1 → reduce k-1        (per segment)
+
+    At most two receives are ever outstanding, so staging never needs
+    more than the chunk itself.  Segment boundaries derive from the
+    shared knob and the (negotiated) transfer sizes, so both endpoints of
+    every link frame identically; zero-size transfers send no frame at
+    all (both sides agree they would be empty).  Sends are views over
+    ``send_arr`` and receives land via ``recv_into`` — the hot loop's
+    only per-byte work is the numpy add."""
+    seg = _segment_elems(send_arr.dtype)
+    sn, rn = int(send_arr.size), int(recv_arr.size)
+    n_send = -(-sn // seg)
+    n_recv = -(-rn // seg)
+    prev_k = -1
+    prev_h = None
+    # One extra iteration drains the final outstanding receive — the
+    # k-bound guards make it a pure wait/reduce pass.
+    for k in range(max(n_send, n_recv) + 1):
+        cur = None
+        if k < n_recv:
+            lo = k * seg
+            cur = mesh.recv_into_async(
+                prv, _byte_view(recv_arr[lo:min(rn, lo + seg)]))
+        if k < n_send:
+            lo = k * seg
+            mesh.send(nxt, _byte_view(send_arr[lo:min(sn, lo + seg)]))
+        if prev_h is not None:
+            prev_h.wait()
+            if reduce_to is not None:
+                lo = prev_k * seg
+                hi = min(rn, lo + seg)
+                _widen_add(reduce_to[lo:hi], recv_arr[lo:hi], wide)
+        prev_k, prev_h = k, cur
+
+
 def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
-                         idx: int, wide: np.dtype) -> np.ndarray:
-    """Ring reduce-scatter over ``group`` (ordered global ranks; ``idx`` is
-    our position).  Returns the chunk bounds; afterwards position ``idx``
-    owns the fully reduced chunk ``(idx + 1) % len(group)``."""
+                         idx: int, wide: np.dtype,
+                         fbm: Optional[FusionBufferManager] = None,
+                         ) -> np.ndarray:
+    """Segment-pipelined ring reduce-scatter over ``group`` (ordered
+    global ranks; ``idx`` is our position).  Returns the chunk bounds;
+    afterwards position ``idx`` owns the fully reduced chunk
+    ``(idx + 1) % len(group)``.
+
+    Incoming segments land in a persistent staging slice (never a
+    per-step allocation) and the only per-byte work on the hot path is
+    the widened numpy add — zero heap copies per step."""
     g = len(group)
     bounds = _chunk_bounds(buf.size, g)
     nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
+    max_chunk = int(bounds[1] - bounds[0])  # chunk 0 is never the smaller
+    stage = fbm.get(buf.dtype, max_chunk, key="ring-stage") \
+        if fbm is not None else np.empty(max_chunk, dtype=buf.dtype)
     for s in range(g - 1):
         send_c = (idx - s) % g
         recv_c = (idx - s - 1) % g
-        recv = mesh.sendrecv(
-            nxt, buf[bounds[send_c]:bounds[send_c + 1]].tobytes(), prv)
-        incoming = np.frombuffer(recv, dtype=buf.dtype)
-        _widen_add(buf[bounds[recv_c]:bounds[recv_c + 1]], incoming, wide)
+        chunk = buf[bounds[recv_c]:bounds[recv_c + 1]]
+        _ring_exchange(mesh, nxt, prv,
+                       buf[bounds[send_c]:bounds[send_c + 1]],
+                       stage[:chunk.size], reduce_to=chunk, wide=wide)
     return bounds
 
 
 def _ring_allgather_chunks(mesh: TcpMesh, buf: np.ndarray, group: List[int],
                            idx: int, bounds: np.ndarray) -> None:
-    """Ring allgather of per-position chunks (each position starts owning
-    chunk ``(idx + 1) % g``, the reduce-scatter ownership)."""
+    """Segment-pipelined ring allgather of per-position chunks (each
+    position starts owning chunk ``(idx + 1) % g``, the reduce-scatter
+    ownership).  Chunks land DIRECTLY in their final location in ``buf``
+    — no staging, no copy; the wire is the only mover."""
     g = len(group)
     nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
     for s in range(g - 1):
         send_c = (idx + 1 - s) % g
         recv_c = (idx - s) % g
-        recv = mesh.sendrecv(
-            nxt, buf[bounds[send_c]:bounds[send_c + 1]].tobytes(), prv)
-        buf[bounds[recv_c]:bounds[recv_c + 1]] = np.frombuffer(
-            recv, dtype=buf.dtype)
+        _ring_exchange(mesh, nxt, prv,
+                       buf[bounds[send_c]:bounds[send_c + 1]],
+                       buf[bounds[recv_c]:bounds[recv_c + 1]])
 
 
 class RingAllreduce(CollectiveOp):
@@ -225,7 +337,7 @@ class RingAllreduce(CollectiveOp):
     def _ring_allreduce(self, buf: np.ndarray, wide: np.dtype) -> np.ndarray:
         group = list(range(self.topo.size))
         bounds = _ring_reduce_scatter(
-            self.mesh, buf, group, self.topo.rank, wide)
+            self.mesh, buf, group, self.topo.rank, wide, self.fusion_buffers)
         _ring_allgather_chunks(
             self.mesh, buf, group, self.topo.rank, bounds)
         return buf
@@ -268,12 +380,14 @@ class HierarchicalAllreduce(RingAllreduce):
                        for c in range(t.cross_size)]
 
         bounds = _ring_reduce_scatter(
-            self.mesh, buf, local_group, t.local_rank, wide)
+            self.mesh, buf, local_group, t.local_rank, wide,
+            self.fusion_buffers)
         own = (t.local_rank + 1) % t.local_size
         seg = buf[bounds[own]:bounds[own + 1]]
         if seg.size:
             seg_bounds = _ring_reduce_scatter(
-                self.mesh, seg, cross_group, t.cross_rank, wide)
+                self.mesh, seg, cross_group, t.cross_rank, wide,
+                self.fusion_buffers)
             _ring_allgather_chunks(
                 self.mesh, seg, cross_group, t.cross_rank, seg_bounds)
         _ring_allgather_chunks(
@@ -286,7 +400,13 @@ class RingAllgather(CollectiveOp):
     block which makes a single trip around the ring; outputs are sliced
     out by the negotiated per-(tensor, rank) first-dim matrix (reference
     allgather fusion + displacement math,
-    ``collective_operations.h:140-176``)."""
+    ``collective_operations.h:140-176``).
+
+    Zero-copy: per-origin blocks live contiguously in one persistent
+    arena (or, single-entry, directly in the output buffer, whose
+    rank-major block order IS the output layout), and every ring step
+    receives straight into the destination block — per-step allocations
+    and ``tobytes``/``frombuffer`` round trips are gone."""
 
     def enabled(self, response, entries) -> bool:
         return response.response_type == ResponseType.ALLGATHER
@@ -303,6 +423,7 @@ class RingAllgather(CollectiveOp):
         inner_ns = [int(np.prod(i)) if i else 1 for i in inners]
 
         if size == 1:
+            wire_stats.add("heap_copies", k)
             for e, t in zip(entries, tensors):
                 e.output = t.copy()
             return Status.OK()
@@ -311,26 +432,47 @@ class RingAllgather(CollectiveOp):
             return sum(m[i * size + r] * inner_ns[i] for i in range(k))
 
         dtype = tensors[0].dtype
-        blocks: List[Optional[np.ndarray]] = [None] * size
-        blocks[rank] = np.concatenate([t.ravel() for t in tensors]) \
-            if k > 1 else tensors[0].ravel()
+        offs = np.cumsum([0] + [block_elems(r) for r in range(size)])
+        total = int(offs[-1])
+        if k == 1:
+            # Rank-major blocks ARE the single tensor's output layout:
+            # gather straight into the output allocation, zero staging.
+            arena = np.empty(total, dtype=dtype)
+        elif self.fusion_buffers is not None:
+            arena = self.fusion_buffers.get(dtype, total, key="allgather")
+        else:
+            arena = np.empty(total, dtype=dtype)
+        blocks = [arena[int(offs[r]):int(offs[r + 1])] for r in range(size)]
 
-        # ring forwarding: at step s we send the block that originated at
-        # (rank - s) and receive the one originated at (rank - s - 1)
+        # Stage our own block into place — the op's one local copy.
+        wire_stats.add("heap_copies", k)
+        own = blocks[rank]
+        off = 0
+        for t in tensors:
+            flat = t.ravel()
+            own[off:off + flat.size] = flat
+            off += flat.size
+
+        # Ring forwarding: at step s we send the block that originated at
+        # (rank - s) and receive the one originated at (rank - s - 1),
+        # segment-pipelined, straight into its arena slot.  recv_into
+        # enforces the exact negotiated block size — a corrupt frame or
+        # desynced negotiation poisons the stream instead of mis-slicing
+        # outputs.
         nxt, prv = (rank + 1) % size, (rank - 1) % size
         for s in range(size - 1):
             send_origin = (rank - s) % size
             recv_origin = (rank - s - 1) % size
-            got = self.mesh.sendrecv(nxt, blocks[send_origin].tobytes(), prv)
-            arr = np.frombuffer(got, dtype=dtype)
-            if arr.size != block_elems(recv_origin):
-                # Loud failure (not assert: stripped under -O) — a corrupt
-                # frame or desynced negotiation must not mis-slice outputs.
-                raise HorovodInternalError(
-                    f"allgather ring block from rank {recv_origin}: got "
-                    f"{arr.size} elems, expected {block_elems(recv_origin)}")
-            blocks[recv_origin] = arr
+            _ring_exchange(self.mesh, nxt, prv,
+                           blocks[send_origin], blocks[recv_origin])
 
+        if k == 1:
+            entries[0].output = arena.reshape((-1,) + inners[0])
+            return Status.OK()
+        # Multi-entry: outputs interleave across blocks, so assembly
+        # materializes each tensor (also required — the arena is reused
+        # by the next fused response).
+        wire_stats.add("heap_copies", k)
         for i, e in enumerate(entries):
             parts = []
             for r in range(size):
@@ -345,7 +487,13 @@ class RingAllgather(CollectiveOp):
 class TreeBroadcast(CollectiveOp):
     """Binomial-tree broadcast: ⌈log2 N⌉ rounds, root sends each payload
     at most log N times instead of N−1 (reference ``gloo::broadcast``
-    tree; VERDICT weak #3 — the old star serialized O(N·bytes) at root)."""
+    tree; VERDICT weak #3 — the old star serialized O(N·bytes) at root).
+
+    Segment-pipelined relay: each landed segment is forwarded to every
+    child while the NEXT segment is still arriving from the parent, so a
+    deep tree streams like a pipeline instead of store-and-forwarding
+    whole payloads at every level.  Non-root ranks receive straight into
+    the output allocation — no intermediate bytes, no final copy."""
 
     def enabled(self, response, entries) -> bool:
         return response.response_type == ResponseType.BROADCAST
@@ -361,11 +509,13 @@ class TreeBroadcast(CollectiveOp):
 
         # Virtual ranks put the root at 0 so the tree math is uniform.
         vrank = (rank - root) % size
+        shape = np.asarray(entry.tensor).shape
         if vrank == 0:
-            payload = np.ascontiguousarray(entry.tensor).tobytes()
+            data = np.ascontiguousarray(entry.tensor).ravel()
             # Never received; may send on every bit below the tree height
             # (next power of two ≥ size — size itself may not be one).
             recv_mask = 1 << (size - 1).bit_length()
+            parent = None
         else:
             # Receive from the parent: the peer that differs in our lowest
             # set bit (it got the payload in an earlier round).
@@ -373,25 +523,46 @@ class TreeBroadcast(CollectiveOp):
             while not (vrank & mask):
                 mask <<= 1
             parent = ((vrank ^ mask) + root) % size
-            payload = self.mesh.recv(parent)
             recv_mask = mask
+            data = np.empty(int(np.asarray(entry.tensor).size),
+                            dtype=response.tensor_type.to_numpy())
 
         # Forward to children: every peer vrank|mask for masks below the
         # one we received on (binomial fan-out).
+        children = []
         mask = recv_mask >> 1
         while mask:
             child_v = vrank | mask
             if child_v != vrank and child_v < size:
-                self.mesh.send((child_v + root) % size, payload)
+                children.append((child_v + root) % size)
             mask >>= 1
+
+        seg = _segment_elems(data.dtype)
+        n = int(data.size)
+        nseg = -(-n // seg)
+        if parent is None:
+            for k in range(nseg):
+                lo, hi = k * seg, min(n, (k + 1) * seg)
+                for child in children:
+                    self.mesh.send(child, _byte_view(data[lo:hi]))
+        else:
+            pending = self.mesh.recv_into_async(
+                parent, _byte_view(data[0:min(n, seg)])) if nseg else None
+            for k in range(nseg):
+                cur, pending = pending, None
+                if k + 1 < nseg:
+                    lo = (k + 1) * seg
+                    pending = self.mesh.recv_into_async(
+                        parent, _byte_view(data[lo:min(n, lo + seg)]))
+                cur.wait()
+                lo, hi = k * seg, min(n, (k + 1) * seg)
+                for child in children:
+                    self.mesh.send(child, _byte_view(data[lo:hi]))
 
         if vrank == 0:
             entry.output = np.ascontiguousarray(entry.tensor)
         else:
-            shape = np.asarray(entry.tensor).shape
-            entry.output = np.frombuffer(
-                payload,
-                dtype=response.tensor_type.to_numpy()).reshape(shape).copy()
+            entry.output = data.reshape(shape)
         return Status.OK()
 
 
@@ -400,6 +571,10 @@ StarBroadcast = TreeBroadcast
 
 
 class PairwiseAlltoall(CollectiveOp):
+    """Pairwise exchange, N−1 rounds of offset sendrecv — each peer's
+    block received straight into its final position in the preallocated
+    output (zero staging, zero assembly concatenate)."""
+
     def enabled(self, response, entries) -> bool:
         return response.response_type == ResponseType.ALLTOALL
 
@@ -416,19 +591,26 @@ class PairwiseAlltoall(CollectiveOp):
         entry.received_splits = recv_splits
 
         inner = tensor.shape[1:]
+        inner_n = int(np.prod(inner)) if inner else 1
         send_bounds = np.cumsum([0] + list(send_splits))
-        out_blocks: List[Optional[np.ndarray]] = [None] * size
-        out_blocks[rank] = tensor[send_bounds[rank]:send_bounds[rank + 1]]
+        recv_bounds = np.cumsum([0] + [s * inner_n for s in recv_splits])
+        out = np.empty(int(recv_bounds[-1]), dtype=tensor.dtype)
+
+        # Our own block goes straight to its final position — the op's
+        # one local copy.
+        wire_stats.add("heap_copies")
+        out[int(recv_bounds[rank]):int(recv_bounds[rank + 1])] = \
+            tensor[send_bounds[rank]:send_bounds[rank + 1]].ravel()
 
         for off in range(1, size):
             to = (rank + off) % size
             frm = (rank - off) % size
-            payload = tensor[send_bounds[to]:send_bounds[to + 1]].tobytes()
-            got = self.mesh.sendrecv(to, payload, frm)
-            out_blocks[frm] = np.frombuffer(got, dtype=tensor.dtype).reshape(
-                (recv_splits[frm],) + inner)
+            _ring_exchange(
+                self.mesh, to, frm,
+                tensor[send_bounds[to]:send_bounds[to + 1]].reshape(-1),
+                out[int(recv_bounds[frm]):int(recv_bounds[frm + 1])])
 
-        entry.output = np.concatenate([out_blocks[i] for i in range(size)], axis=0)
+        entry.output = out.reshape((-1,) + inner)
         return Status.OK()
 
 

@@ -69,6 +69,14 @@ HOROVOD_WIRE_CRC = "HOROVOD_WIRE_CRC"
 # Elastic blacklist cooldown: a blacklisted host rejoins the candidate
 # pool after this many seconds (0 = permanent, the reference behavior).
 HOROVOD_BLACKLIST_COOLDOWN_SECS = "HOROVOD_BLACKLIST_COOLDOWN_SECS"
+# -- host data plane --
+# Ring-collective pipeline granularity (bytes): each ring step streams its
+# chunk as segments of this size so segment k reduces in numpy while
+# segment k+1 is on the wire (backend/cpu_ring.py; docs/data_plane.md).
+# Clamped to at least one element; values >= the chunk size degrade to the
+# unpipelined single-frame step.  All ranks must agree (launcher-propagated
+# like every knob — peers derive identical segment boundaries from it).
+HOROVOD_RING_SEGMENT_BYTES = "HOROVOD_RING_SEGMENT_BYTES"
 # Lockdep-style runtime lock-order validator (common/lockdep.py): when
 # truthy, Lock/RLock created inside this package are instrumented and an
 # exit-time report names lock-order inversion cycles and blocking waits
@@ -133,6 +141,15 @@ DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_CHECK_TIME_SECONDS = 60
 DEFAULT_STALL_SHUTDOWN_TIME_SECONDS = 0  # disabled
 DEFAULT_TCP_PROGRESS_DEADLINE_SECS = 600.0
+# 1 MiB: small enough that the numpy add of segment k genuinely overlaps
+# segment k+1's wire time on MB-scale chunks, large enough that the
+# per-segment cost (framing + helper-thread hop + context switch) stays
+# noise.  Measured on the 1-core CI box (where overlap CANNOT pay — the
+# "wire" is loopback CPU, so segmentation is pure overhead there): 4 MB
+# np=2 medians 24.3 ms @ 1 MiB vs 28.9 @ 256 KiB vs 35.0 @ 64 KiB vs
+# 24.4 unpipelined — 1 MiB is at parity with unpipelined even with no
+# core to overlap on; see benchmarks/results/ring_segment_sweep.json.
+DEFAULT_RING_SEGMENT_BYTES = 1024 * 1024
 DEFAULT_SPARK_INLINE_MAX_ROWS = 100_000
 DEFAULT_LOCK_DEBUG_SLOW_SECS = 1.0
 

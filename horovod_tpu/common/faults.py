@@ -31,8 +31,11 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, clauses joined by ``;``)::
                                CRC check must catch it
                truncate[,NBYTES]  shorten the payload by NBYTES BEFORE
                                framing (send-only): header and CRC agree
-                               with the short payload, so only the
-                               defensive parse layer can catch it
+                               with the short payload, so the wire CRC
+                               passes and a later layer must catch it
+                               (defensive parse on the control plane;
+                               recv_into's exact-size check on the
+                               zero-copy data plane)
 
 Examples::
 
@@ -189,11 +192,16 @@ class SendMutation:
     frames and CRCs this, so a truncated frame is self-consistent and only
     the defensive parse layer can catch it.  ``wire_flips`` are
     (offset, xor) byte flips applied AFTER the CRC is computed
-    (``corrupt``): in-flight corruption the wire CRC must catch."""
+    (``corrupt``): in-flight corruption the wire CRC must catch.
+
+    ``payload`` is any bytes-like object — the zero-copy transport passes
+    memoryviews over numpy staging slices, and truncation stays a view
+    (slicing a memoryview); only ``wire_bytes`` with flips pending
+    materializes, since it must mutate."""
 
     __slots__ = ("payload", "wire_flips")
 
-    def __init__(self, payload: bytes,
+    def __init__(self, payload,
                  wire_flips: List[Tuple[int, int]]):
         self.payload = payload
         self.wire_flips = wire_flips
@@ -234,7 +242,7 @@ def _default_rank() -> int:
 
 
 def inject(site: str, rank: Optional[int] = None,
-           peer: Optional[int] = None, payload: Optional[bytes] = None):
+           peer: Optional[int] = None, payload=None):
     """Fire any matching clause for this call.
 
     Returns ``False`` when nothing payload-affecting fired, ``True`` when

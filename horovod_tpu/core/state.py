@@ -272,7 +272,7 @@ class HorovodGlobalState:
         self.op_manager.register(
             ResponseType.ALLREDUCE, cpu_ring.RingAllreduce(topo, mesh, fbm))
         self.op_manager.register(
-            ResponseType.ALLGATHER, cpu_ring.RingAllgather(topo, mesh))
+            ResponseType.ALLGATHER, cpu_ring.RingAllgather(topo, mesh, fbm))
         self.op_manager.register(
             ResponseType.BROADCAST, cpu_ring.TreeBroadcast(topo, mesh))
         self.op_manager.register(

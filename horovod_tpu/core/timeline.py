@@ -181,3 +181,52 @@ class PhaseStats:
 #: Process-global instance — the background loop, the XLA backend, and the
 #: framework-side handle waits all record into this.
 phase_stats = PhaseStats()
+
+
+class CounterStats:
+    """Monotonic event counters for the host data plane.
+
+    The companion to :class:`PhaseStats` for quantities that are counts,
+    not durations:
+
+    - ``bytes_on_wire``: DATA payload bytes the TCP transport actually
+      framed (sender side) or delivered (receiver side).  Each data frame
+      is counted once per endpoint, so a process's number is its own
+      traffic; control frames (coordinated abort) are excluded on both
+      sides — they are teardown traffic, and counting them on only one
+      side would break sender/receiver symmetry.
+    - ``heap_copies``: payload materializations in the host data plane
+      (``backend/cpu_ring.py`` / ``backend/adasum.py``) — every site that
+      still copies tensor bytes onto the heap (fuse staging, unfuse
+      ``copy=True``, output assembly) increments it.  The zero-copy
+      invariant the test suite asserts: a steady-state ring *step*
+      contributes **zero** (reduction reads staged segments in place;
+      nothing is ever ``tobytes()``'d or ``frombuffer``-copied).
+
+    Cheap enough to leave always-on (one dict update under a lock per
+    event; the transport batches per frame, not per syscall)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def add(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+#: Process-global data-plane counters (bytes_on_wire, heap_copies);
+#: surfaced by the benches' ``--profile`` output next to ``phase_stats``.
+wire_stats = CounterStats()

@@ -7,9 +7,20 @@ run over those sockets.  We are MPI- and gloo-free by design (north star), so
 this module is that fabric: a framed, thread-safe, full-mesh TCP transport
 bootstrapped through a ``Store``.
 
-Framing: 4-byte little-endian length + payload.  Connection establishment is
+Framing: ``<Q len|flags><I crc32(payload)>`` + payload — an 8-byte
+little-endian length word whose top bit marks control frames
+(``_CTRL_FLAG``), followed by a 4-byte CRC32 of the payload when
+``HOROVOD_WIRE_CRC`` is on (the default; the CRC field is absent entirely
+when it is off), then the payload bytes.  Connection establishment is
 deterministic to avoid crossed sockets: every rank listens; rank *i* dials
 every rank *j < i* and introduces itself with an 8-byte hello (magic + rank).
+
+Zero-copy data plane: ``send`` accepts any C-contiguous bytes-like object
+(a memoryview over a numpy slice included) and writes ``[header, payload]``
+vectored, never concatenating; ``recv_into`` lands a frame's payload
+directly in a caller-provided buffer, computing the wire CRC incrementally
+over the destination view as bytes arrive — no intermediate heap
+materialization on either side (docs/data_plane.md).
 
 Only the background/controller thread performs transport I/O in steady state,
 but sends and recvs are independently locked per peer so the elastic
@@ -35,6 +46,7 @@ from ..common.exceptions import (
     PeerGoneError,
 )
 from ..common.logging_util import get_logger
+from ..core.timeline import wire_stats
 from .store import Store
 
 log = get_logger("horovod_tpu.transport.tcp")
@@ -94,6 +106,44 @@ def _wait_readable(sock: socket.socket, timeout: float) -> bool:
 
 def _wait_writable(sock: socket.socket, timeout: float) -> bool:
     return _wait_ready(sock, timeout, write=True)
+
+
+def _as_byte_view(data) -> memoryview:
+    """Flat byte view over any C-contiguous bytes-like object — bytes,
+    bytearray, memoryview, or a numpy array/slice — without copying.
+    Raises for non-contiguous input: the caller holds a strided view it
+    must materialize itself (silently copying here would defeat the
+    zero-copy contract and hide the cost)."""
+    view = data if isinstance(data, memoryview) else memoryview(data)
+    if view.format != "B" or view.ndim != 1:
+        view = view.cast("B")
+    return view
+
+
+def _as_writable_byte_view(data) -> memoryview:
+    view = _as_byte_view(data)
+    if view.readonly:
+        raise ValueError("recv_into needs a writable destination buffer")
+    return view
+
+
+class PendingRecv:
+    """Handle for an in-flight ``recv_into_async``: ``wait()`` blocks until
+    the frame landed and returns its payload size, re-raising any
+    transport error (PeerGoneError, CoordinatedAbortError,
+    FrameCorruptError) on the caller's thread."""
+
+    __slots__ = ("_done", "_box")
+
+    def __init__(self, done: threading.Event, box: List):
+        self._done = done
+        self._box = box
+
+    def wait(self) -> int:
+        self._done.wait()
+        if self._box[1] is not None:
+            raise self._box[1]
+        return self._box[0]
 
 
 class _Peer:
@@ -443,11 +493,16 @@ class TcpMesh:
         if p.dead is None:
             p.dead = reason
 
-    def send(self, peer: int, payload: bytes) -> None:
+    def send(self, peer: int, payload) -> None:
+        """Frame and send one payload — any C-contiguous bytes-like object
+        (memoryview over a numpy slice included), never copied: the frame
+        header and the payload view go to the kernel as one vectored
+        write."""
         p = self._peer(peer)
         with p.send_lock:
             self._check_alive(p, peer)
             try:
+                payload = _as_byte_view(payload)
                 wire = payload
                 if faults.ACTIVE:
                     verdict = faults.inject(
@@ -462,13 +517,13 @@ class TcpMesh:
                         # corrupt: wire_flips apply AFTER the CRC is
                         # computed — in-flight corruption for the CRC
                         # layer.
-                        payload = verdict.payload
-                        wire = verdict.wire_bytes()
+                        payload = _as_byte_view(verdict.payload)
+                        wire = _as_byte_view(verdict.wire_bytes())
                 header = _LEN.pack(len(payload))
                 if self.wire_crc:
                     header += _CRC.pack(zlib.crc32(payload) & 0xFFFFFFFF)
-                self._send_bounded(p, header)
-                self._send_bounded(p, wire)
+                self._send_bounded(p, [memoryview(header), wire])
+                wire_stats.add("bytes_on_wire", len(payload))
             except _ProgressStall as e:
                 self._mark_dead(p, str(e))
                 raise PeerGoneError(peer, str(e)) from None
@@ -477,21 +532,27 @@ class TcpMesh:
                 raise PeerGoneError(
                     peer, f"send to rank {peer} failed: {e}") from e
 
-    def _send_bounded(self, p: _Peer, data: bytes) -> None:
-        """``sendall`` with the same failure-plane waits as the recv side:
-        a peer that is alive but has stopped READING (hung mid-step) fills
-        the socket buffer and a plain sendall would block forever — TCP
-        never errors on a live-but-idle peer.  Any bytes the peer's stack
-        accepts reset the progress clock; the mesh-wide abort flag is
-        observed every poll quantum.  No first-bytes arming needed: the
-        kernel accepts into the receive buffer even while the peer app is
-        still initializing, so bring-up stagger cannot trip this."""
+    def _send_bounded(self, p: _Peer, bufs: List[memoryview]) -> None:
+        """Vectored ``sendall`` with the same failure-plane waits as the
+        recv side: a peer that is alive but has stopped READING (hung
+        mid-step) fills the socket buffer and a plain sendall would block
+        forever — TCP never errors on a live-but-idle peer.  Any bytes the
+        peer's stack accepts reset the progress clock; the mesh-wide abort
+        flag is observed every poll quantum.  No first-bytes arming
+        needed: the kernel accepts into the receive buffer even while the
+        peer app is still initializing, so bring-up stagger cannot trip
+        this.
+
+        ``bufs`` is a writev(2)-style list (typically ``[header,
+        payload]``) pushed via ``sendmsg`` so header and payload reach the
+        kernel in one syscall without ever being concatenated on the
+        heap."""
         sock = p.sock
-        view = memoryview(data)
-        sent = 0
+        bufs = [b for b in bufs if len(b)]
+        use_sendmsg = hasattr(sock, "sendmsg")
         budget = self.progress_deadline
         deadline = (time.monotonic() + budget) if budget > 0 else None
-        while sent < len(data):
+        while bufs:
             if self._abort is not None:
                 raise CoordinatedAbortError(*self._abort)
             if not _wait_writable(sock, _ABORT_POLL_SECS):
@@ -501,14 +562,25 @@ class TcpMesh:
                         f"(HOROVOD_TCP_PROGRESS_DEADLINE_SECS={budget:g})")
                 continue
             try:
-                r = sock.send(view[sent:])
+                r = sock.sendmsg(bufs) if use_sendmsg \
+                    else sock.send(bufs[0])
             except BlockingIOError:
                 continue  # lost the race to buffer space; re-poll
-            sent += r
+            while r > 0:
+                if r >= len(bufs[0]):
+                    r -= len(bufs[0])
+                    bufs.pop(0)
+                else:
+                    bufs[0] = bufs[0][r:]
+                    r = 0
             if deadline is not None:
                 deadline = time.monotonic() + budget
 
     def recv(self, peer: int) -> bytes:
+        """Receive one data frame, materialized as fresh ``bytes`` — the
+        control/negotiation-plane primitive.  The data plane uses
+        :meth:`recv_into` instead, which lands the payload straight in a
+        caller-owned buffer with no heap materialization."""
         p = self._peer(peer)
         with p.recv_lock:
             self._check_alive(p, peer)
@@ -516,16 +588,10 @@ class TcpMesh:
                 if faults.ACTIVE:
                     faults.inject("tcp.recv", rank=self.rank, peer=peer)
                 while True:
-                    n = _LEN.unpack(self._recv_bounded(p, _LEN.size))[0]
-                    size = n & ~_CTRL_FLAG
-                    if size > _MAX_FRAME_BYTES:
-                        self._poison_stream(p, peer, HorovodInternalError(
-                            f"frame header from rank {peer} claims "
-                            f"{size} bytes (cap {_MAX_FRAME_BYTES}): "
-                            "corrupted length word; aborting before "
-                            "allocating it"))
-                    crc = _CRC.unpack(self._recv_bounded(p, _CRC.size))[0] \
-                        if self.wire_crc else None
+                    ctrl, size, crc = self._recv_header(p, peer)
+                    if ctrl:
+                        self._consume_control_frame(p, peer, size, crc)
+                        continue  # stale control frame: keep reading
                     payload = self._recv_bounded(p, size)
                     p.frames_in += 1
                     if crc is not None:
@@ -535,9 +601,7 @@ class TcpMesh:
                                 p, peer,
                                 FrameCorruptError(peer, p.frames_in,
                                                   crc, got))
-                    if n & _CTRL_FLAG:
-                        self._handle_control(payload, peer)
-                        continue  # stale control frame: keep reading
+                    wire_stats.add("bytes_on_wire", size)
                     return payload
             except _ProgressStall as e:
                 self._mark_dead(p, str(e))
@@ -547,17 +611,113 @@ class TcpMesh:
                 raise PeerGoneError(
                     peer, f"recv from rank {peer} failed: {e}") from e
 
+    def recv_into(self, peer: int, dest) -> int:
+        """Receive one data frame's payload directly into ``dest`` (a
+        writable C-contiguous bytes-like — typically a memoryview over a
+        numpy staging slice); returns the payload size.
+
+        Zero-copy contract: bytes go from the kernel straight into
+        ``dest`` via ``socket.recv_into``, and the wire CRC is folded
+        incrementally over each landed span (``zlib.crc32`` accepts
+        memoryviews), so integrity stays default-on with no intermediate
+        buffer.  The frame must fill ``dest`` EXACTLY: the caller sized it
+        from the same negotiated layout the sender framed from, so any
+        mismatch (a truncating fault, a desynced negotiation) poisons the
+        stream like a CRC failure — reading on after a misframe would
+        turn one bad frame into positional desync.
+
+        Control frames (coordinated abort) interleave transparently, as
+        on the :meth:`recv` path."""
+        p = self._peer(peer)
+        dv = _as_writable_byte_view(dest)
+        with p.recv_lock:
+            self._check_alive(p, peer)
+            try:
+                if faults.ACTIVE:
+                    faults.inject("tcp.recv", rank=self.rank, peer=peer)
+                while True:
+                    ctrl, size, crc = self._recv_header(p, peer)
+                    if ctrl:
+                        self._consume_control_frame(p, peer, size, crc)
+                        continue  # stale control frame: keep reading
+                    if size != len(dv):
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"data frame from rank {peer} carries {size} "
+                            f"bytes but the recv_into destination expects "
+                            f"{len(dv)}: misframed stream (truncated or "
+                            "desynced); aborting, resync is impossible by "
+                            "design"))
+                    got = self._recv_bounded_into(
+                        p, dv, with_crc=crc is not None)
+                    p.frames_in += 1
+                    if crc is not None and got != crc:
+                        self._poison_stream(
+                            p, peer,
+                            FrameCorruptError(peer, p.frames_in, crc, got))
+                    wire_stats.add("bytes_on_wire", size)
+                    return size
+            except _ProgressStall as e:
+                self._mark_dead(p, str(e))
+                raise PeerGoneError(peer, str(e)) from None
+            except OSError as e:
+                self._mark_dead(p, f"recv from rank {peer} failed: {e}")
+                raise PeerGoneError(
+                    peer, f"recv from rank {peer} failed: {e}") from e
+
+    def _consume_control_frame(self, p: _Peer, peer: int, size: int,
+                               crc: Optional[int]) -> None:
+        """Read, CRC-verify, and handle one control frame — shared by the
+        materializing ``recv`` and the zero-copy ``recv_into`` so the two
+        receive paths cannot diverge.  Returns normally only for STALE
+        control frames (``_handle_control`` discards them); control
+        traffic is deliberately NOT counted in ``bytes_on_wire`` on
+        either side (see ``CounterStats``)."""
+        payload = self._recv_bounded(p, size)
+        p.frames_in += 1
+        if crc is not None:
+            got = zlib.crc32(payload) & 0xFFFFFFFF
+            if got != crc:
+                self._poison_stream(
+                    p, peer,
+                    FrameCorruptError(peer, p.frames_in, crc, got))
+        self._handle_control(payload, peer)
+
+    def _recv_header(self, p: _Peer, peer: int):
+        """Read one frame header: ``(is_control, payload_size, crc|None)``."""
+        n = _LEN.unpack(self._recv_bounded(p, _LEN.size))[0]
+        size = n & ~_CTRL_FLAG
+        if size > _MAX_FRAME_BYTES:
+            self._poison_stream(p, peer, HorovodInternalError(
+                f"frame header from rank {peer} claims "
+                f"{size} bytes (cap {_MAX_FRAME_BYTES}): "
+                "corrupted length word; aborting before "
+                "allocating it"))
+        crc = _CRC.unpack(self._recv_bounded(p, _CRC.size))[0] \
+            if self.wire_crc else None
+        return bool(n & _CTRL_FLAG), size, crc
+
     def _recv_bounded(self, p: _Peer, n: int) -> bytes:
-        """``_recv_exact`` with the failure-plane waits: wakes every
-        ``_ABORT_POLL_SECS`` to observe a mesh-wide abort (which may have
-        arrived on a different peer's link) and enforces the progress
-        deadline — *any* bytes received reset it.  The deadline only
-        applies once the peer has EVER sent bytes (see ``_Peer``): the
-        first-ever frame may legitimately lag the whole bring-up stagger."""
-        sock = p.sock
         buf = bytearray(n)
-        view = memoryview(buf)
+        self._recv_bounded_into(p, memoryview(buf), with_crc=False)
+        return bytes(buf)
+
+    def _recv_bounded_into(self, p: _Peer, view: memoryview,
+                           with_crc: bool) -> Optional[int]:
+        """``_recv_exact`` into a caller view, with the failure-plane
+        waits: wakes every ``_ABORT_POLL_SECS`` to observe a mesh-wide
+        abort (which may have arrived on a different peer's link) and
+        enforces the progress deadline — *any* bytes received reset it.
+        The deadline only applies once the peer has EVER sent bytes (see
+        ``_Peer``): the first-ever frame may legitimately lag the whole
+        bring-up stagger.
+
+        With ``with_crc``, folds CRC32 over each landed span as it
+        arrives and returns the final digest — the incremental-CRC half of
+        the zero-copy recv path."""
+        sock = p.sock
+        n = len(view)
         got = 0
+        crc = 0
         budget = self.progress_deadline
         deadline = (time.monotonic() + budget) \
             if budget > 0 and p.ever_received else None
@@ -576,6 +736,8 @@ class TcpMesh:
                 continue  # readable raced away (non-blocking socket)
             if r == 0:
                 raise OSError("peer closed connection")
+            if with_crc:
+                crc = zlib.crc32(view[got:got + r], crc)
             got += r
             if not p.ever_received:
                 p.ever_received = True
@@ -583,7 +745,7 @@ class TcpMesh:
                     deadline = time.monotonic() + budget
             elif deadline is not None:
                 deadline = time.monotonic() + budget
-        return bytes(buf)
+        return (crc & 0xFFFFFFFF) if with_crc else None
 
     def _poison_stream(self, p: _Peer, peer: int,
                        err: HorovodInternalError) -> None:
@@ -663,7 +825,7 @@ class TcpMesh:
                     pass
                 p.send_lock.release()
 
-    def sendrecv(self, send_to: int, payload: bytes, recv_from: int) -> bytes:
+    def sendrecv(self, send_to: int, payload, recv_from: int) -> bytes:
         """Concurrent send+recv — the ring-collective step primitive.
 
         A sequential send-then-recv deadlocks on rings once payloads exceed
@@ -687,6 +849,39 @@ class TcpMesh:
         if box[1] is not None:
             raise box[1]
         return box[0]
+
+    def recv_into_async(self, peer: int, dest) -> PendingRecv:
+        """Post a :meth:`recv_into` on the persistent helper thread and
+        return a :class:`PendingRecv` handle — the segment-pipeline
+        primitive: the collective layer posts the recv for segment k+1,
+        sends its own segment, then reduces segment k while k+1 is still
+        on the wire.
+
+        Posts are FIFO on one helper thread, so posting recvs for
+        segments k and k+1 back-to-back maps them onto the peer's frames
+        in wire order."""
+        done = threading.Event()
+        box: List = [None, None]  # [nbytes, error]
+
+        def _recv():
+            try:
+                box[0] = self.recv_into(peer, dest)
+            except BaseException as e:  # noqa: BLE001
+                box[1] = e
+            finally:
+                done.set()
+
+        self._sr_submit(_recv)
+        return PendingRecv(done, box)
+
+    def sendrecv_into(self, send_to: int, payload, recv_from: int,
+                      dest) -> int:
+        """Zero-copy ``sendrecv``: concurrent send of ``payload`` (any
+        bytes-like view) and recv of exactly ``len(dest)`` bytes straight
+        into ``dest``.  Returns the received payload size."""
+        pending = self.recv_into_async(recv_from, dest)
+        self.send(send_to, payload)
+        return pending.wait()
 
     def _sr_submit(self, task) -> None:
         if self._sr_thread is None or not self._sr_thread.is_alive():

@@ -1,0 +1,248 @@
+"""Zero-copy, segment-pipelined host ring: bit-exactness and the
+no-heap-copy counter guard.
+
+The guard is DETERMINISTIC: steady-state ring steps must perform zero
+payload materializations, asserted through the ``wire_stats.heap_copies``
+counter (``core/timeline.py``) — never through wall-clock thresholds,
+which this box's ±20% bench noise would make flaky.  Bit-exactness uses
+integer-valued floats so the ring's reduction order cannot perturb the
+reference ``np.sum``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.backend import cpu_ring
+from horovod_tpu.common import env as env_mod
+from horovod_tpu.core.tensor_queue import TensorTableEntry
+from horovod_tpu.core.timeline import wire_stats
+from horovod_tpu.transport import MemoryStore, TcpMesh
+
+from .test_transport import run_ranks
+
+pytestmark = pytest.mark.smoke
+
+
+def _entry(tensor):
+    return TensorTableEntry(tensor_name="t", tensor=tensor,
+                            callback=lambda s, e: None)
+
+
+def _ring_allreduce_threads(arrays, fbms=None, timeout=60):
+    """Drive the pipelined ring primitives directly: len(arrays) thread
+    ranks over an in-process mesh, each reducing+allgathering its buffer
+    in place (the exact code path ``RingAllreduce._ring_allreduce``
+    runs)."""
+    size = len(arrays)
+    store = MemoryStore()
+
+    def fn(rank):
+        mesh = TcpMesh(rank, size, store, bind_addr="127.0.0.1",
+                       advertise_addr="127.0.0.1", timeout=15)
+        try:
+            buf = arrays[rank]
+            wide = cpu_ring._accum_dtype(buf.dtype)
+            fbm = fbms[rank] if fbms is not None else None
+            group = list(range(size))
+            bounds = cpu_ring._ring_reduce_scatter(
+                mesh, buf, group, rank, wide, fbm)
+            cpu_ring._ring_allgather_chunks(mesh, buf, group, rank, bounds)
+        finally:
+            mesh.close()
+
+    run_ranks(size, fn, timeout=timeout)
+    return arrays
+
+
+def _expected_sum(inputs, dtype):
+    """Reference: exact elementwise sum (fp64 accumulate), cast back."""
+    acc = np.zeros(inputs[0].shape, np.float64)
+    for x in inputs:
+        acc += np.asarray(x, np.float64)
+    return acc.astype(dtype)
+
+
+def _int_valued(n, rank, dtype):
+    """Integer-valued payloads: exactly representable in every tested
+    dtype (fp16/bf16 included), so any reduction ORDER gives the same
+    bits and the ring can be compared against np.sum bit-for-bit."""
+    return ((np.arange(n) + rank) % 5 + rank + 1).astype(dtype)
+
+
+_DTYPES = [np.float32, np.float64, np.float16, np.int32, np.int64]
+try:
+    import ml_dtypes
+
+    # The narrow-wire extension dtype: no PEP-3118 buffer format, so it
+    # exercises the uint8-reinterpret _byte_view fallback.
+    _DTYPES.append(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
+
+
+@pytest.mark.parametrize("dtype", _DTYPES, ids=lambda d: np.dtype(d).name)
+@pytest.mark.parametrize("n", [1, 7, 1023])
+def test_pipelined_ring_bit_exact(dtype, n):
+    """Pipelined ring allreduce == np.sum, bit for bit, across dtypes
+    (including the fp16/bf16 narrow-wire paths) and element counts that
+    divide evenly by neither the world size nor the segment size."""
+    size = 3
+    dtype = np.dtype(dtype)
+    inputs = [_int_valued(n, r, dtype) for r in range(size)]
+    expected = _expected_sum(inputs, dtype)
+    outs = _ring_allreduce_threads([x.copy() for x in inputs])
+    for r in range(size):
+        got = np.asarray(outs[r], np.float64)
+        want = np.asarray(expected, np.float64)
+        assert np.array_equal(got, want), (r, got[:8], want[:8])
+
+
+@pytest.mark.parametrize("seg_bytes", ["1", str(1 << 30)])
+def test_segment_size_edge_cases(monkeypatch, seg_bytes):
+    """The knob's extremes both reduce correctly: 1 byte (clamped to one
+    element per segment — maximal pipelining) and larger than the chunk
+    (degrades to the unpipelined single-frame step)."""
+    monkeypatch.setenv(env_mod.HOROVOD_RING_SEGMENT_BYTES, seg_bytes)
+    size, n = 2, 13
+    inputs = [_int_valued(n, r, np.float32) for r in range(size)]
+    expected = _expected_sum(inputs, np.float32)
+    outs = _ring_allreduce_threads([x.copy() for x in inputs])
+    for out in outs:
+        assert np.array_equal(out, expected)
+
+
+def test_one_element_segments_really_segment(monkeypatch):
+    """HOROVOD_RING_SEGMENT_BYTES=1 clamps to one element — sanity that
+    the clamp math holds for every itemsize."""
+    monkeypatch.setenv(env_mod.HOROVOD_RING_SEGMENT_BYTES, "1")
+    assert cpu_ring._segment_elems(np.dtype(np.float64)) == 1
+    assert cpu_ring._segment_elems(np.dtype(np.float16)) == 1
+    monkeypatch.setenv(env_mod.HOROVOD_RING_SEGMENT_BYTES, "1024")
+    assert cpu_ring._segment_elems(np.dtype(np.float32)) == 256
+
+
+def test_large_payload_pipeline_no_deadlock():
+    """Segments beyond socket-buffer capacity must stream, not deadlock:
+    the exchange posts its receive before each send (and the recvs run on
+    the helper thread), so every rank always drains while it pushes."""
+    size, n = 3, 1_500_001  # ~6 MB/rank of float32, odd on purpose
+    inputs = [np.full(n, float(r + 1), np.float32) for r in range(size)]
+    outs = _ring_allreduce_threads([x.copy() for x in inputs], timeout=120)
+    for out in outs:
+        assert np.array_equal(out, np.full(n, 6.0, np.float32))
+
+
+def test_steady_state_ring_step_zero_heap_copies():
+    """THE zero-copy guard: after one warm allreduce (staging arenas
+    allocated), a steady-state ring pass performs ZERO heap
+    materializations of payload bytes — and moves exactly the predicted
+    number of payload bytes over the wire.  Counter-asserted; no timing
+    anywhere."""
+    size, n = 3, 999
+    dtype = np.dtype(np.float32)
+    fbms = [cpu_ring.FusionBufferManager() for _ in range(size)]
+    inputs = [_int_valued(n, r, dtype) for r in range(size)]
+
+    # Warm pass: allocates per-rank staging arenas inside the managers.
+    _ring_allreduce_threads([x.copy() for x in inputs], fbms)
+
+    before = wire_stats.snapshot()
+    outs = _ring_allreduce_threads([x.copy() for x in inputs], fbms)
+    after = wire_stats.snapshot()
+
+    assert np.array_equal(outs[0], _expected_sum(inputs, dtype))
+    assert after.get("heap_copies", 0) == before.get("heap_copies", 0), \
+        "a steady-state ring step materialized payload bytes on the heap"
+
+    # Exact wire accounting: every rank sends g-1 chunks in each phase;
+    # sender and receiver both count, and all ranks share this process.
+    bounds = cpu_ring._chunk_bounds(n, size)
+    sent_elems = 0
+    for idx in range(size):
+        for s in range(size - 1):
+            c = (idx - s) % size            # reduce-scatter send chunk
+            sent_elems += int(bounds[c + 1] - bounds[c])
+            c = (idx + 1 - s) % size        # allgather send chunk
+            sent_elems += int(bounds[c + 1] - bounds[c])
+    expected_wire = 2 * sent_elems * dtype.itemsize  # send + recv counts
+    got_wire = after.get("bytes_on_wire", 0) - before.get("bytes_on_wire", 0)
+    assert got_wire == expected_wire, (got_wire, expected_wire)
+
+
+# ---------------------------------------------------------------------------
+# fuse/unfuse copy discipline (satellite: the single-entry double-copy)
+# ---------------------------------------------------------------------------
+
+
+def test_fuse_single_entry_one_copy_no_alias():
+    """Single-entry fuse makes exactly ONE copy (counter-asserted) and
+    never aliases the user's tensor — for contiguous, transposed, and
+    Fortran-ordered inputs alike."""
+    for t in (np.arange(12, dtype=np.float32).reshape(3, 4),
+              np.arange(12, dtype=np.float32).reshape(3, 4).T,
+              np.asfortranarray(
+                  np.arange(12, dtype=np.float64).reshape(3, 4))):
+        before = wire_stats.get("heap_copies")
+        out = cpu_ring.fuse_entries([_entry(t)], t.dtype)
+        assert wire_stats.get("heap_copies") == before + 1
+        assert np.array_equal(out, np.asarray(t).ravel())
+        assert not np.shares_memory(out, t), "fuse returned a view"
+        # the ravel after astype must be a VIEW (the one copy already
+        # happened); a second materialization would hide here
+        assert out.base is not None
+        out[...] = -1.0
+        assert float(np.asarray(t).ravel()[0]) != -1.0
+
+
+def test_fuse_single_entry_casts_once():
+    t = np.arange(6, dtype=np.float64)
+    out = cpu_ring.fuse_entries([_entry(t)], np.dtype(np.float32))
+    assert out.dtype == np.float32
+    assert np.array_equal(out, t.astype(np.float32))
+
+
+def test_unfuse_staged_outputs_do_not_alias_arena():
+    """The aliasing contract: when the fused buffer is the persistent
+    arena, ``unfuse_entries(..., copy=True)`` must hand out OWNED
+    outputs — the next fused response overwrites the arena."""
+    fbm = cpu_ring.FusionBufferManager()
+    e1 = _entry(np.ones(8, np.float32))
+    e2 = _entry(np.full(8, 2.0, np.float32))
+    buf = cpu_ring.fuse_entries([e1, e2], np.dtype(np.float32), fbm)
+    assert buf.base is not None  # staged into the arena
+    cpu_ring.unfuse_entries(buf, [e1, e2], copy=True)
+    arena = fbm.get(np.dtype(np.float32), 16)
+    assert not np.shares_memory(e1.output, arena)
+    assert not np.shares_memory(e2.output, arena)
+    arena[:] = 99.0  # next cycle reuses the arena...
+    assert np.array_equal(e1.output, np.ones(8, np.float32))
+    assert np.array_equal(e2.output, np.full(8, 2.0, np.float32))
+
+
+def test_fusion_buffer_keys_are_disjoint():
+    """The ring's receive staging must never alias the fusion buffer the
+    work payload lives in — keyed arenas guarantee it."""
+    fbm = cpu_ring.FusionBufferManager()
+    fusion = fbm.get(np.dtype(np.float32), 64)
+    stage = fbm.get(np.dtype(np.float32), 64, key="ring-stage")
+    assert not np.shares_memory(fusion, stage)
+    # same key + dtype still shares one arena
+    again = fbm.get(np.dtype(np.float32), 32, key="ring-stage")
+    assert np.shares_memory(stage, again)
+
+
+def test_byte_view_refuses_noncontiguous():
+    """_byte_view must raise on strided views, never silently copy."""
+    arr = np.arange(16, dtype=np.float32)[::2]
+    with pytest.raises((ValueError, AttributeError)):
+        cpu_ring._byte_view(arr)
+
+
+def test_byte_view_covers_extension_dtypes():
+    ml = pytest.importorskip("ml_dtypes")
+    arr = np.arange(8, dtype=ml.bfloat16)
+    view = cpu_ring._byte_view(arr)
+    assert len(view) == arr.size * arr.dtype.itemsize
+    assert not view.readonly

@@ -541,6 +541,177 @@ def test_sendrecv_helper_recovers_after_task_error():
             m.close()
 
 
+# ---------------------------------------------------------------------------
+# zero-copy data plane: view sends, recv_into, incremental CRC
+# ---------------------------------------------------------------------------
+
+
+def test_send_accepts_numpy_views_and_recv_into_lands_in_place():
+    """The zero-copy pair: a numpy slice goes out as a view (no tobytes)
+    and the payload lands directly in a caller buffer (no fresh bytes),
+    with the default-on wire CRC verified incrementally over the
+    destination."""
+    import numpy as np
+
+    meshes = _mesh_pair()
+    try:
+        src = np.arange(64, dtype=np.float32)
+        dest = np.zeros(16, dtype=np.float32)
+        meshes[0].send(1, memoryview(src[8:24]).cast("B"))
+        got = meshes[1].recv_into(0, memoryview(dest).cast("B"))
+        assert got == 64
+        assert np.array_equal(dest, src[8:24])
+    finally:
+        for m in meshes:
+            m.close()
+
+
+def test_sendrecv_into_concurrent_exchange():
+    import numpy as np
+
+    meshes = _mesh_pair()
+    payloads = [np.full(1024, float(r), np.float32) for r in range(2)]
+    outs = [np.empty(1024, np.float32) for _ in range(2)]
+    results = [None, None]
+
+    def fn(rank):
+        results[rank] = meshes[rank].sendrecv_into(
+            1 - rank, memoryview(payloads[rank]).cast("B"),
+            1 - rank, memoryview(outs[rank]).cast("B"))
+
+    try:
+        threads = [threading.Thread(target=fn, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20)
+            assert not t.is_alive(), "sendrecv_into wedged"
+        for rank in range(2):
+            assert results[rank] == 4096
+            assert np.array_equal(outs[rank], payloads[1 - rank])
+    finally:
+        for m in meshes:
+            m.close()
+
+
+def test_recv_into_size_mismatch_poisons_stream():
+    """A data frame whose size disagrees with the caller's negotiated
+    destination is positional desync in the making (a truncating fault, a
+    desynced negotiation): the stream must be poisoned — peer dead,
+    coordinated abort broadcast — exactly like a CRC failure."""
+    from horovod_tpu.common.exceptions import (
+        CoordinatedAbortError,
+        HorovodInternalError,
+    )
+
+    meshes = _mesh_pair()
+    try:
+        meshes[0].send(1, b"x" * 10)
+        dest = bytearray(16)
+        with pytest.raises(HorovodInternalError, match="misframed"):
+            meshes[1].recv_into(0, memoryview(dest))
+        # the abort reached the sending side
+        with pytest.raises(CoordinatedAbortError):
+            meshes[0].recv(1)
+    finally:
+        for m in meshes:
+            m.close()
+
+
+def test_recv_into_wire_crc_catches_inflight_corruption():
+    """The incremental CRC over the recv_into destination must catch an
+    injected in-flight flip exactly like the materializing recv path —
+    typed FrameCorruptError, peer marked dead, abort broadcast back."""
+    import numpy as np
+
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import (
+        CoordinatedAbortError,
+        FrameCorruptError,
+    )
+
+    meshes = _mesh_pair()
+    try:
+        src = np.ones(256, np.float32)
+        dest = np.empty(256, np.float32)
+        meshes[0].send(1, memoryview(src).cast("B"))
+        assert meshes[1].recv_into(0, memoryview(dest).cast("B")) == 1024
+        faults.configure("tcp.send:rank=0:nth=1:action=corrupt,2")
+        meshes[0].send(1, memoryview(src).cast("B"))
+        with pytest.raises(FrameCorruptError) as exc:
+            meshes[1].recv_into(0, memoryview(dest).cast("B"))
+        assert exc.value.peer == 0 and exc.value.frame_index == 2
+        with pytest.raises(CoordinatedAbortError, match="wire CRC"):
+            meshes[0].recv(1)
+    finally:
+        faults.reset()
+        for m in meshes:
+            m.close()
+
+
+def test_recv_into_truncate_fault_caught_as_misframe():
+    """``action=truncate`` on the view path: header and CRC agree with
+    the short payload, so the CRC passes — and the size check against the
+    negotiated destination is what catches it (poison + abort, never a
+    silent short read into the staging buffer)."""
+    import numpy as np
+
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    meshes = _mesh_pair()
+    try:
+        faults.configure("tcp.send:rank=0:nth=1:action=truncate,4")
+        src = np.ones(64, np.float32)
+        meshes[0].send(1, memoryview(src).cast("B"))
+        dest = np.empty(64, np.float32)
+        with pytest.raises(HorovodInternalError, match="misframed"):
+            meshes[1].recv_into(0, memoryview(dest).cast("B"))
+    finally:
+        faults.reset()
+        for m in meshes:
+            m.close()
+
+
+def test_abort_frame_interleaves_with_recv_into():
+    """A control frame (coordinated abort) arriving while a recv_into is
+    posted must surface as CoordinatedAbortError on the view path too."""
+    from horovod_tpu.common.exceptions import CoordinatedAbortError
+
+    meshes = _mesh_pair()
+    try:
+        errs = []
+
+        def blocked():
+            try:
+                meshes[0].recv_into(1, memoryview(bytearray(128)))
+            except CoordinatedAbortError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=blocked, daemon=True)
+        t.start()
+        import time as time_mod
+
+        time_mod.sleep(0.2)
+        meshes[1].send_abort("pipelined step abort")
+        t.join(5)
+        assert not t.is_alive(), "abort did not unblock recv_into"
+        assert errs and errs[0].origin_rank == 1
+    finally:
+        for m in meshes:
+            m.close()
+
+
+def test_recv_into_rejects_readonly_destination():
+    meshes = _mesh_pair()
+    try:
+        with pytest.raises(ValueError, match="writable"):
+            meshes[1].recv_into(0, memoryview(b"readonly"))
+    finally:
+        for m in meshes:
+            m.close()
+
+
 def test_tcp_mesh_multi_addr_fallback():
     """Dialers fall through dead advertised addresses to a live one
     (NIC-negotiation role, reference driver_service.py:162-194).  The
