@@ -119,10 +119,15 @@ def main() -> None:
         want_cpu = True
         probe = {"ok": True, "platform": "cpu", "skipped": True}
     else:
+        # Fail FAST to the honest CPU headline: r05 burned 6+ minutes on
+        # 3×120 s probe timeouts before ever starting the CPU bench (the
+        # wedge never healed within the retry window — it never does on
+        # this box).  One bounded attempt decides; operators on flaky
+        # real TPUs can raise both knobs.
         probe = _probe_accelerator(
             timeout_s=float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT_S",
-                                           "120")),
-            retries=int(os.environ.get("HVD_BENCH_PROBE_RETRIES", "3")))
+                                           "60")),
+            retries=int(os.environ.get("HVD_BENCH_PROBE_RETRIES", "1")))
         want_cpu = not probe["ok"]
         if want_cpu:
             error = "tpu_unavailable"

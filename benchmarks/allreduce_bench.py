@@ -129,6 +129,11 @@ def main() -> int:
                    help="sweep HOROVOD_RING_SEGMENT_BYTES over these "
                         "values (interleaved) at --sizes[0] per world "
                         "size; 0 means chunk-sized (pipeline off)")
+    p.add_argument("--metrics-sweep", action="store_true",
+                   help="run --sizes[0] with HOROVOD_METRICS on AND off "
+                        "(interleaved) and report the overhead ratio — "
+                        "the observability plane's ±10%% guard "
+                        "(docs/observability.md)")
     p.add_argument("--out", type=str, default=None,
                    help="write result records to this JSON file")
     args = p.parse_args()
@@ -159,6 +164,26 @@ def main() -> int:
                 })
                 results.append(rec)
                 print(json.dumps(rec), flush=True)
+    elif args.metrics_sweep:
+        nbytes = args.sizes[0]
+        for np_ in args.world_sizes:
+            variants = [("on", {"HOROVOD_METRICS": "1"}),
+                        ("off", {"HOROVOD_METRICS": "0"})]
+            medians, samples = _interleaved_medians(
+                variants, args.repeats, nbytes, np_, args.rounds)
+            rec = _record(nbytes, np_, medians["on"])
+            rec.update({
+                "metric": "eager_allreduce_metrics_overhead",
+                "step_ms_metrics_on": round(medians["on"] * 1e3, 3),
+                "step_ms_metrics_off": round(medians["off"] * 1e3, 3),
+                "metrics_on_off_ratio": round(
+                    medians["on"] / medians["off"], 3),
+                "samples_ms": {k: [round(s * 1e3, 3) for s in v]
+                               for k, v in samples.items()},
+                "repeats": args.repeats,
+            })
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
     elif args.crc_sweep:
         for nbytes in args.sizes:
             for np_ in args.world_sizes:

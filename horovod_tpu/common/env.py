@@ -86,6 +86,26 @@ HOROVOD_LOCK_DEBUG = "HOROVOD_LOCK_DEBUG"
 # Acquire waits longer than this (seconds) while holding another lock are
 # recorded as held-lock blocking waits in the lockdep report.
 HOROVOD_LOCK_DEBUG_SLOW_SECS = "HOROVOD_LOCK_DEBUG_SLOW_SECS"
+# -- observability plane (docs/observability.md) --
+# Metrics registry master switch ("1"/"0", default on): counters, gauges
+# and latency histograms in core/metrics.py.  Always-on by design (like
+# wire_stats); "0" turns every recording call into one attribute read —
+# benchmarks/allreduce_bench.py --metrics-sweep is the overhead guard.
+HOROVOD_METRICS = "HOROVOD_METRICS"
+# Period (seconds) between a worker's metrics-snapshot pushes to the
+# rendezvous KV (PUT /metrics/rank-N, served back aggregated by the
+# server's GET /metrics).  0 disables pushing; recording still happens.
+HOROVOD_METRICS_PUSH_SECS = "HOROVOD_METRICS_PUSH_SECS"
+# Flight recorder ("1"/"0", default on): bounded in-memory ring of recent
+# events (frames, cycles, faults, epoch changes) dumped as a per-rank
+# post-mortem JSON when the background loop dies (coordinated abort,
+# frame corruption, any fatal error).
+HOROVOD_FLIGHT_RECORDER = "HOROVOD_FLIGHT_RECORDER"
+# Directory the post-mortem dumps land in (default: the worker's cwd —
+# next to its logs); file name hvd_flight_recorder.rank<N>.json.
+HOROVOD_FLIGHT_RECORDER_DIR = "HOROVOD_FLIGHT_RECORDER_DIR"
+# Ring capacity (events retained; oldest evicted first).
+HOROVOD_FLIGHT_RECORDER_EVENTS = "HOROVOD_FLIGHT_RECORDER_EVENTS"
 
 # -- core runtime tunables (reference common.h:64-91) --
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"  # bytes, default 64MB
@@ -152,6 +172,14 @@ DEFAULT_TCP_PROGRESS_DEADLINE_SECS = 600.0
 DEFAULT_RING_SEGMENT_BYTES = 1024 * 1024
 DEFAULT_SPARK_INLINE_MAX_ROWS = 100_000
 DEFAULT_LOCK_DEBUG_SLOW_SECS = 1.0
+# 5 s: fast enough that a scrape of a live job is near-current, slow
+# enough that N ranks' pushes are noise to the rendezvous server (one
+# small PUT per rank per period).
+DEFAULT_METRICS_PUSH_SECS = 5.0
+# 512 events ≈ the last few busy cycles' frames plus every rare event
+# (faults, epoch changes, aborts) — sized so idle control-frame chatter
+# cannot evict a whole incident's history.
+DEFAULT_FLIGHT_RECORDER_EVENTS = 512
 
 
 def get_int(name: str, default: int) -> int:

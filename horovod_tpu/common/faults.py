@@ -262,6 +262,7 @@ def inject(site: str, rank: Optional[int] = None,
     drop = False
     mutation: Optional[SendMutation] = None
     for clause in fire:
+        _record_fire(clause, site, rank)
         if clause.action in ("corrupt", "truncate"):
             if payload is None:
                 continue  # parse-time guard keeps these on tcp.send
@@ -273,6 +274,23 @@ def inject(site: str, rank: Optional[int] = None,
     if drop:
         return True  # drop wins over a concurrent mutation
     return mutation if mutation is not None else False
+
+
+def _record_fire(clause: _Clause, site: str, rank: int) -> None:
+    """Stamp a fired clause into the observability plane BEFORE its action
+    runs — ``exit``/``hang`` never return, and a post-mortem flight dump
+    that can't name the injected fault defeats the chaos suite's purpose.
+    Lazy imports keep the common→core dependency off the module graph
+    (fires are rare by definition)."""
+    try:
+        from ..core import flight_recorder, metrics
+
+        metrics.inc("faults_injected_total")
+        flight_recorder.record("fault", site=site, rank=rank,
+                               action=clause.action, call=clause.calls)
+    except Exception:  # noqa: BLE001 — observability must never change
+        # the injected failure's shape
+        pass
 
 
 def _mutate_payload(clause: _Clause, mutation: SendMutation) -> None:

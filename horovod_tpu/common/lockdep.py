@@ -50,6 +50,7 @@ __all__ = [
     "install", "uninstall", "is_installed", "requested", "reset",
     "snapshot", "restore", "slow_secs", "set_slow_secs",
     "edges", "find_cycles", "slow_waits", "report", "check",
+    "current_held",
 ]
 
 _MODULE_PREFIXES = ("horovod_tpu", "tests", "__main__", "__mp_main__")
@@ -84,6 +85,13 @@ def _held_stack() -> list:
     except AttributeError:
         _tls.held = []
         return _tls.held
+
+
+def current_held() -> List[str]:
+    """Creation sites of the locks the CALLING thread currently holds —
+    the flight recorder stamps this into post-mortem dumps (a loop that
+    died while holding something is the smoking gun)."""
+    return [entry[1] for entry in _held_stack()]
 
 
 def _creation_site() -> Optional[str]:

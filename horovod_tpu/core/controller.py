@@ -40,6 +40,7 @@ from ..common import faults
 from ..common.logging_util import get_logger
 from ..common.topology import ProcessTopology
 from ..transport.tcp import TcpMesh
+from . import metrics
 from .messages import (
     DataType,
     MaskFrame,
@@ -877,6 +878,16 @@ class Controller:
         if now - self._last_stall_check < min(enabled):
             return
         self._last_stall_check = now
+        # Surface the inspector's view into the metrics registry: how many
+        # tensors are currently past the stall threshold (gauge, refreshed
+        # every check) and how many hard shutdowns ever fired (counter).
+        stall_age = min(t for t in (warn, shut) if t > 0)
+        stalled = sum(
+            1 for e in self._message_table.values()
+            if now - e.first_seen > stall_age)
+        stalled += sum(1 for since in self._mask_bit_since.values()
+                       if now - since > stall_age)
+        metrics.set_gauge("stalled_tensors", stalled)
         for name, entry in self._message_table.items():
             age = now - entry.first_seen
             missing = sorted(set(range(self.topo.size))
@@ -888,6 +899,7 @@ class Controller:
                 # forever on the missing ones.
                 from ..common.exceptions import HorovodInternalError
 
+                metrics.inc("stall_shutdowns_total")
                 raise HorovodInternalError(
                     f"stall shutdown: tensor {name} incomplete for "
                     f"{age:.0f}s (> {shut}s), missing ranks {missing}")
@@ -923,6 +935,7 @@ class Controller:
 
                 tpl = self._cache.rehydrate(bit, 0) if self._cache else None
                 name = tpl.tensor_name if tpl else f"<bit {bit}>"
+                metrics.inc("stall_shutdowns_total")
                 raise HorovodInternalError(
                     f"stall shutdown: cached tensor {name} incomplete for "
                     f"{age:.0f}s (> {shut}s), missing ranks {missing}")

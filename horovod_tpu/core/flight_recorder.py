@@ -1,0 +1,140 @@
+"""Flight recorder — always-on bounded event ring + post-mortem dumps.
+
+The failure plane (PR 2/4) guarantees a *loud, coordinated* death, but
+"why" still meant log archaeology across N ranks.  This module keeps a
+small in-memory ring of recent events on every rank — wire frames,
+negotiation cycles, fired fault clauses, epoch changes, abort traffic —
+and, when the background loop dies (``CoordinatedAbortError``,
+``FrameCorruptError``, any fatal error), dumps ``{reason, metrics
+snapshot, last-K events, held locks if lockdep is active}`` to a per-rank
+JSON file next to the worker's log.  The chaos suite asserts the dump
+exists and parses on every rank after an injected corruption abort.
+
+Recording is a deque append under a small lock (~1 µs) and is enabled by
+default; ``HOROVOD_FLIGHT_RECORDER=0`` reduces every ``record`` call to
+one attribute read.  Dumps are written atomically (tmp + ``os.replace``)
+so a process dying mid-dump can never leave a half-written post-mortem.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from ..common import env as env_mod
+from ..common.logging_util import get_logger
+
+log = get_logger("horovod_tpu.flight_recorder")
+
+DUMP_FORMAT = "hvd-flight-recorder-v1"
+
+
+def _dump_filename(rank: int) -> str:
+    return f"hvd_flight_recorder.rank{rank}.json"
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reconfigure()
+
+    def reconfigure(self) -> None:
+        """(Re)read the env knobs — workers configure at import from the
+        launcher-propagated env; tests re-point the dir/capacity."""
+        self.enabled = env_mod.get_bool(env_mod.HOROVOD_FLIGHT_RECORDER,
+                                        True)
+        maxlen = max(1, env_mod.get_int(
+            env_mod.HOROVOD_FLIGHT_RECORDER_EVENTS,
+            env_mod.DEFAULT_FLIGHT_RECORDER_EVENTS))
+        with self._lock:
+            old = list(getattr(self, "_events", []))
+            self._events: collections.deque = collections.deque(
+                old[-maxlen:], maxlen=maxlen)
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        evt = {"t_mono": time.monotonic(), "t_wall": time.time(),
+               "thread": threading.current_thread().name, "kind": kind}
+        evt.update(fields)
+        with self._lock:
+            self._events.append(evt)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def dump(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+        """Write the post-mortem JSON; returns the path (None when the
+        recorder is disabled).  Never raises — a failing dump must not
+        mask the error being dumped (the caller logs the verdict)."""
+        if not self.enabled:
+            return None
+        rank = env_mod.get_int(env_mod.HOROVOD_RANK, 0)
+        if path is None:
+            dump_dir = env_mod.get_str(
+                env_mod.HOROVOD_FLIGHT_RECORDER_DIR) or "."
+            path = os.path.join(dump_dir, _dump_filename(rank))
+        doc = {
+            "format": DUMP_FORMAT,
+            "reason": reason,
+            "rank": rank,
+            "pid": os.getpid(),
+            "ts_unix": time.time(),
+            "held_locks": self._held_locks(),
+            "metrics": self._metrics_snapshot(),
+            "events": self.events(),
+        }
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        except OSError as e:
+            log.error("flight-recorder dump to %s failed: %s", path, e)
+            return None
+
+    @staticmethod
+    def _metrics_snapshot() -> Optional[dict]:
+        from . import metrics
+
+        if not metrics.ENABLED:
+            return None
+        try:
+            return metrics.registry.snapshot()
+        except Exception as e:  # noqa: BLE001 — the dump must still land
+            return {"error": f"metrics snapshot failed: {e}"}
+
+    @staticmethod
+    def _held_locks() -> Optional[List[str]]:
+        """The dumping thread's held-lock sites, when lockdep is on —
+        a loop that died while holding something is the smoking gun."""
+        from ..common import lockdep
+
+        if not lockdep.is_installed():
+            return None
+        try:
+            return lockdep.current_held()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            return None
+
+
+#: Process-global recorder every instrumented site records into.
+recorder = FlightRecorder()
+
+
+def record(kind: str, **fields) -> None:
+    """Module-level convenience mirroring :func:`metrics.inc` — one
+    attribute read when the recorder is disabled."""
+    if recorder.enabled:
+        recorder.record(kind, **fields)

@@ -1,0 +1,366 @@
+"""Process-global metrics registry — the cluster observability plane's core.
+
+Role of the reference's timeline/stall-inspector telemetry plus what it
+never had: a scrapeable, cross-rank metrics surface.  Three instrument
+kinds, Prometheus-shaped:
+
+- **Counter**: monotonically increasing float (``inc``); merged across
+  ranks by summation at scrape time.
+- **Gauge**: last-written value (``set_gauge``); labeled by rank at
+  scrape time (a queue depth summed across ranks would be a lie).
+- **Histogram**: fixed log2 buckets (powers of two from ~1 µs to 64 s,
+  ``observe``); per-bucket counts merge across ranks by summation, so a
+  cluster-wide latency distribution is exact, not approximated.
+
+Labels ride as keyword arguments (``observe("collective_latency_seconds",
+dt, op="ALLREDUCE", dtype="FLOAT32", size="2^22")``) and are flattened
+into the Prometheus ``name{k="v"}`` form for storage and merging.
+
+``phase_stats`` and ``wire_stats`` (core/timeline.py) predate this
+registry and stay the hot-path accumulators; they are absorbed as
+**registered views** — callables folded into every :func:`snapshot`, so
+one scrape carries the whole process's story.  The controller's
+fast-cycle counters join the same way (core/state.py registers the view).
+
+Every metric name must be declared in :data:`CATALOG` — lint rule HVD007
+(mirror of HVD003's fault-site registry) rejects an ``inc``/``observe``/
+``set_gauge``/stats-``add`` call whose literal name is not cataloged, and
+requires every catalog entry to appear in ``docs/observability.md``.  A
+typo'd metric name must not silently record nothing.
+
+Always-on by default like ``wire_stats`` (one small lock + dict update
+per event); ``HOROVOD_METRICS=0`` turns every recording call into one
+attribute read (the ``faults.ACTIVE`` pattern), and
+``benchmarks/allreduce_bench.py --metrics-sweep`` is the overhead guard.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common import env as env_mod
+
+#: Rendezvous-KV scope the workers push snapshots into (``PUT
+#: /metrics/rank-N``) and the server's ``GET /metrics`` aggregates from.
+METRICS_SCOPE = "metrics"
+
+#: Prefix stamped onto every rendered Prometheus series.
+PROM_PREFIX = "hvd_"
+
+#: Fixed log2 histogram bucket upper bounds: 2^-20 s (~1 µs) .. 2^6 s
+#: (64 s), plus an implicit +Inf overflow bucket.  Fixed (not
+#: configurable) so per-rank bucket arrays always merge element-wise.
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(2.0 ** k for k in range(-20, 7))
+
+#: The metric catalog: every observable name, its kind, and its meaning.
+#: ``counter``/``gauge``/``histogram`` entries are recorded through this
+#: module; ``stat`` entries are the raw names fed to the pre-existing
+#: ``phase_stats``/``wire_stats`` accumulators (their registered views
+#: surface them here as the ``phase_*``/``wire_*`` counters).  This dict
+#: is the HVD007-enforced registry: call sites may only use names listed
+#: here, and every name here must appear in ``docs/observability.md``.
+CATALOG: Dict[str, Tuple[str, str]] = {
+    # -- controller / negotiation plane --
+    "controller_cycles_total": (
+        "counter", "negotiation cycles completed (busy and idle)"),
+    "controller_fast_cycles_total": (
+        "counter", "zero-payload mask-only cycles that completed work"),
+    "controller_idle_fast_cycles_total": (
+        "counter", "zero-payload mask-only cycles with nothing to do"),
+    "controller_serialized_requests_total": (
+        "counter", "Requests this rank put on / took off the wire"),
+    "controller_fast_cycle_ratio": (
+        "gauge", "(fast + idle-fast cycles) / all cycles since init"),
+    "controller_cycle_seconds": (
+        "histogram", "busy negotiation-round duration (idle parks excluded)"),
+    "tensor_queue_depth": (
+        "gauge", "tensors in flight (submitted, not yet completed)"),
+    # -- collectives --
+    "collective_latency_seconds": (
+        "histogram", "host-side dispatch latency per negotiated response, "
+                     "labeled op/dtype/size (device-async ops record the "
+                     "host dispatch cost; device completion is the "
+                     "finalizer's)"),
+    # -- stall inspector --
+    "stalled_tensors": (
+        "gauge", "tensors currently past the stall-warning age "
+                 "(coordinator only; updated each stall check)"),
+    "stall_shutdowns_total": (
+        "counter", "hard stall-shutdown aborts fired (coordinator only)"),
+    # -- rendezvous / elastic --
+    "rendezvous_store_ops_total": (
+        "counter", "HTTP KV store requests, labeled op=get|set|delete"),
+    "elastic_epoch": ("gauge", "membership epoch this process last adopted"),
+    "elastic_epoch_changes_total": (
+        "counter", "elastic re-rendezvous epoch adoptions"),
+    # -- integrity / failure plane --
+    "crc_verify_seconds_total": (
+        "counter", "seconds spent computing/verifying wire CRC32 "
+                   "(ROADMAP item 2's direct measurement)"),
+    "aborts_total": (
+        "counter", "coordinated aborts, labeled dir=sent|received"),
+    "faults_injected_total": (
+        "counter", "fault-injection clauses fired (chaos runs only)"),
+    # -- registered views (phase_stats / wire_stats) --
+    "phase_seconds_total": (
+        "counter", "accumulated wall time per dispatch-chain phase "
+                   "(phase_stats view; labeled phase=)"),
+    "phase_ops_total": (
+        "counter", "events per dispatch-chain phase (phase_stats view)"),
+    "wire_bytes_on_wire_total": (
+        "counter", "data payload bytes framed/delivered by the TCP "
+                   "transport (wire_stats view)"),
+    "wire_heap_copies_total": (
+        "counter", "payload materializations in the host data plane "
+                   "(wire_stats view; the zero-copy guard's counter)"),
+    # -- raw stat names (the literals fed to phase_stats/wire_stats.add;
+    #    HVD007 checks those call sites against this catalog too) --
+    "negotiate": ("stat", "phase_stats: controller round, busy cycles"),
+    "fuse": ("stat", "phase_stats: staging the fused buffer"),
+    "collective": ("stat", "phase_stats: host cost of the collective"),
+    "unfuse": ("stat", "phase_stats: slicing results to outputs"),
+    "wait": ("stat", "phase_stats: framework-thread handle waits"),
+    "bytes_on_wire": ("stat", "wire_stats: per-frame payload bytes"),
+    "heap_copies": ("stat", "wire_stats: data-plane materializations"),
+}
+
+#: Fast-path flag (the ``faults.ACTIVE`` pattern): when False every
+#: module-level recording call returns after one attribute read.
+ENABLED = env_mod.get_bool(env_mod.HOROVOD_METRICS, True)
+
+
+def configure(enabled: Optional[bool] = None) -> None:
+    """Set (or re-read from the environment) the enable flag — tests and
+    the bench sweep use this; production processes inherit the env."""
+    global ENABLED
+    if enabled is None:
+        enabled = env_mod.get_bool(env_mod.HOROVOD_METRICS, True)
+    ENABLED = bool(enabled)
+
+
+def flat(name: str, **labels) -> str:
+    """Flatten a metric name + labels into the Prometheus series form
+    (``name{k="v",...}``, keys sorted).  Label values must not contain
+    ``"`` or newlines — enforced here because the flat string is also the
+    storage/merge key and the renderer re-parses it."""
+    if not labels:
+        return name
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k])
+        if '"' in v or "\n" in v:
+            raise ValueError(f"label value {v!r} for {k} contains a "
+                             "forbidden character")
+        parts.append(f'{k}="{v}"')
+    return name + "{" + ",".join(parts) + "}"
+
+
+def parse_flat(flat_name: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of :func:`flat` (for the renderer's rank-label injection)."""
+    if "{" not in flat_name:
+        return flat_name, {}
+    base, _, rest = flat_name.partition("{")
+    labels: Dict[str, str] = {}
+    for item in rest.rstrip("}").split(","):
+        if not item:
+            continue
+        k, _, v = item.partition("=")
+        labels[k] = v.strip('"')
+    return base, labels
+
+
+def size_bucket_label(nbytes: int) -> str:
+    """Power-of-two-ceiling size label (``4 MiB`` → ``2^22``) for the
+    per-collective latency histogram's ``size=`` dimension."""
+    if nbytes <= 1:
+        return "2^0"
+    return f"2^{(int(nbytes) - 1).bit_length()}"
+
+
+class MetricsRegistry:
+    """One process's metric state.  All mutation is under one small lock;
+    views are called OUTSIDE it (they hold their own locks)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        # flat -> [per-bucket counts (len(BUCKET_BOUNDS)+1), sum, count]
+        self._hists: Dict[str, List] = {}
+        self._views: Dict[str, Callable[[], dict]] = {}
+
+    # -- recording ------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1, **labels) -> None:
+        key = flat(name, **labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        key = flat(name, **labels)
+        with self._lock:
+            self._gauges[key] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = flat(name, **labels)
+        idx = bisect.bisect_left(BUCKET_BOUNDS, value)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = [[0] * (len(BUCKET_BOUNDS) + 1),
+                                        0.0, 0]
+            h[0][idx] += 1
+            h[1] += value
+            h[2] += 1
+
+    def register_view(self, name: str,
+                      fn: Callable[[], dict]) -> None:
+        """Register (or replace) a snapshot view: ``fn()`` returns
+        ``{"counters": {flat: v}, "gauges": {flat: v}}`` folded into
+        every snapshot.  Re-registration under the same name replaces —
+        elastic re-initialization must not accumulate stale closures."""
+        with self._lock:
+            self._views[name] = fn
+
+    # -- reading --------------------------------------------------------
+
+    def get_counter(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get(flat(name, **labels), 0)
+
+    def get_gauge(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(flat(name, **labels))
+
+    def snapshot(self) -> dict:
+        """JSON-able copy of everything, views folded in — the unit the
+        push thread ships to the rendezvous KV."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: {"counts": list(h[0]), "sum": h[1], "count": h[2]}
+                     for k, h in self._hists.items()}
+            views = list(self._views.items())
+        for _, fn in views:
+            try:
+                out = fn() or {}
+            except Exception:  # noqa: BLE001 — a broken view must not
+                # take down the scrape; the other series still matter.
+                continue
+            counters.update(out.get("counters", {}))
+            gauges.update(out.get("gauges", {}))
+        return {
+            "version": 1,
+            "rank": env_mod.get_int(env_mod.HOROVOD_RANK, 0),
+            "ts_unix_ns": time.time_ns(),
+            "bucket_bounds": list(BUCKET_BOUNDS),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+#: The process-global registry every instrumented site records into.
+registry = MetricsRegistry()
+
+
+# -- module-level conveniences (the instrumented-site API; one attribute
+#    read when disabled, like faults.ACTIVE) -------------------------------
+
+
+def inc(name: str, value: float = 1, **labels) -> None:
+    if ENABLED:
+        registry.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    if ENABLED:
+        registry.set_gauge(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    if ENABLED:
+        registry.observe(name, value, **labels)
+
+
+# -- cross-rank merge + Prometheus text rendering --------------------------
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _help_type(base: str, kind: str, out: List[str],
+               emitted: set) -> None:
+    if base in emitted:
+        return
+    emitted.add(base)
+    entry = CATALOG.get(base)
+    help_text = entry[1] if entry else ""
+    out.append(f"# HELP {PROM_PREFIX}{base} {help_text}")
+    out.append(f"# TYPE {PROM_PREFIX}{base} {kind}")
+
+
+def render_prometheus(snapshots: Dict) -> str:
+    """Aggregate per-rank snapshot dicts into Prometheus text format
+    (version 0.0.4): counters and histogram buckets summed across ranks,
+    gauges labeled by rank.  ``snapshots`` maps any key to a snapshot
+    dict; the rank comes from each snapshot's own ``rank`` field."""
+    counters: Dict[str, float] = {}
+    hists: Dict[str, List] = {}
+    gauge_lines: List[Tuple[str, str, float]] = []  # (base, flat+rank, v)
+    for key, snap in sorted(snapshots.items(), key=lambda kv: str(kv[0])):
+        if not isinstance(snap, dict):
+            continue
+        rank = snap.get("rank", key)
+        for k, v in snap.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, h in snap.get("histograms", {}).items():
+            counts = list(h.get("counts", []))
+            agg = hists.get(k)
+            if agg is None:
+                hists[k] = [counts, float(h.get("sum", 0.0)),
+                            int(h.get("count", 0)),
+                            list(snap.get("bucket_bounds", BUCKET_BOUNDS))]
+            elif len(agg[0]) == len(counts):
+                agg[0] = [a + b for a, b in zip(agg[0], counts)]
+                agg[1] += float(h.get("sum", 0.0))
+                agg[2] += int(h.get("count", 0))
+        for k, v in snap.get("gauges", {}).items():
+            base, labels = parse_flat(k)
+            labels["rank"] = str(rank)
+            gauge_lines.append((base, flat(base, **labels), v))
+
+    out: List[str] = []
+    emitted: set = set()
+    for k in sorted(counters):
+        base, _ = parse_flat(k)
+        _help_type(base, "counter", out, emitted)
+        out.append(f"{PROM_PREFIX}{k} {_fmt(counters[k])}")
+    for base, flat_name, v in sorted(gauge_lines, key=lambda t: t[1]):
+        _help_type(base, "gauge", out, emitted)
+        out.append(f"{PROM_PREFIX}{flat_name} {_fmt(v)}")
+    for k in sorted(hists):
+        counts, total, n, bounds = hists[k]
+        base, labels = parse_flat(k)
+        _help_type(base, "histogram", out, emitted)
+        cum = 0
+        for i, bound in enumerate(list(bounds) + [float("inf")]):
+            cum += counts[i] if i < len(counts) else 0
+            le = "+Inf" if bound == float("inf") else repr(bound)
+            out.append(PROM_PREFIX
+                       + flat(base + "_bucket", **{**labels, "le": le})
+                       + f" {cum}")
+        out.append(f"{PROM_PREFIX}{flat(base + '_sum', **labels)} "
+                   f"{_fmt(total)}")
+        out.append(f"{PROM_PREFIX}{flat(base + '_count', **labels)} {n}")
+    return "\n".join(out) + ("\n" if out else "")

@@ -37,6 +37,7 @@ from ..common.logging_util import get_logger
 from ..common.topology import ProcessTopology, from_env
 from ..transport.store import HTTPStoreClient, MemoryStore, Store
 from ..transport.tcp import TcpMesh
+from . import flight_recorder, metrics
 from .controller import BARRIER_TENSOR_NAME, JOIN_TENSOR_NAME, Controller
 from .messages import (
     DataType,
@@ -196,16 +197,30 @@ class HorovodGlobalState:
             self._sync_controller_topology(store, epoch, startup_timeout)
         timeline_path = env_mod.get_str(env_mod.HOROVOD_TIMELINE)
         if timeline_path:
-            # Reference writes the timeline only on the coordinator
-            # (operations.cc:424-432).
-            if topo.rank == 0:
-                from .timeline import Timeline
+            # EVERY rank writes a trace (pid = rank; rank 0 keeps the
+            # configured path, others get <path>.rankN) so
+            # tools/trace_merge.py can build the one cross-rank view; the
+            # coordinator-side negotiation lanes still exist only on rank
+            # 0 (the message table lives there, reference
+            # operations.cc:424-432).
+            from .timeline import (
+                Timeline,
+                estimate_server_clock_offset_ns,
+                rank_trace_path,
+            )
 
-                self.timeline = Timeline(
-                    timeline_path,
-                    mark_cycles=env_mod.get_bool(
-                        env_mod.HOROVOD_TIMELINE_MARK_CYCLES))
+            self.timeline = Timeline(
+                rank_trace_path(timeline_path, topo.rank),
+                mark_cycles=env_mod.get_bool(
+                    env_mod.HOROVOD_TIMELINE_MARK_CYCLES),
+                rank=topo.rank,
+                clock_offset_ns=estimate_server_clock_offset_ns())
+            if topo.rank == 0:
                 self.controller.timeline = self.timeline
+        metrics.registry.register_view("controller",
+                                       self._controller_metrics_view)
+        if store is not None:
+            self._start_metrics_pusher(store)
         self._register_default_ops()
 
     def _sync_controller_topology(self, store, epoch: int,
@@ -240,6 +255,65 @@ class HorovodGlobalState:
                 f"world size) differs across ranks; propagate the same "
                 f"value to every host (a star/tree mismatch would deadlock "
                 f"the first negotiation round)")
+
+    def _controller_metrics_view(self) -> dict:
+        """Metrics-registry view over the controller's fast-path counters
+        (registered at init; re-registration on elastic re-init replaces
+        the stale closure).  Runs only at snapshot time — the negotiation
+        hot path pays nothing for these."""
+        c = self.controller
+        if c is None:
+            return {}
+        cycles = max(1, self.cycle_count)
+        fast = c.fast_cycle_count + c.idle_fast_cycle_count
+        return {
+            "counters": {
+                "controller_cycles_total": self.cycle_count,
+                "controller_fast_cycles_total": c.fast_cycle_count,
+                "controller_idle_fast_cycles_total": c.idle_fast_cycle_count,
+                "controller_serialized_requests_total":
+                    c.serialized_request_count,
+            },
+            "gauges": {"controller_fast_cycle_ratio": fast / cycles},
+        }
+
+    def _start_metrics_pusher(self, store) -> None:
+        """Periodically push this rank's metrics snapshot to the
+        rendezvous KV (``PUT /metrics/rank-N``) so the server's
+        ``GET /metrics`` can serve a cross-rank aggregate of a LIVE job.
+        One small PUT per period; 0 disables."""
+        period = env_mod.get_float(env_mod.HOROVOD_METRICS_PUSH_SECS,
+                                   env_mod.DEFAULT_METRICS_PUSH_SECS)
+        if period <= 0 or not metrics.ENABLED:
+            return
+        import json as json_mod
+
+        rank = self.topo.rank
+        done = self.shutdown_complete
+
+        def _push() -> None:
+            try:
+                snap = metrics.registry.snapshot()
+                snap["rank"] = rank
+                # Epoch-stamped so the scrape can drop snapshots from
+                # ranks that left at an elastic re-rendezvous (their last
+                # push would otherwise be served forever).
+                snap["epoch"] = env_mod.get_epoch()
+                store.set(metrics.METRICS_SCOPE, f"rank-{rank}",
+                          json_mod.dumps(snap).encode())
+            except Exception as e:  # noqa: BLE001 — a scrape gap must
+                # never hurt the job; the store may be restarting.
+                log.debug("metrics push failed: %s", e)
+
+        def _push_loop() -> None:
+            _push()
+            while not done.wait(period):
+                _push()
+            _push()  # final snapshot so short jobs still land one
+
+        threading.Thread(target=_push_loop,
+                         name=f"hvd-metrics-push-r{rank}",
+                         daemon=True).start()
 
     def _register_default_ops(self) -> None:
         topo, mesh = self.topo, self.mesh
@@ -336,6 +410,7 @@ class HorovodGlobalState:
             if self.async_error is None:
                 self.async_error = str(e)
             self._broadcast_abort(e)
+            self._dump_flight_recorder(e)
             self._stop_dispatcher()
             self._fail_all_pending(str(e))
         else:
@@ -354,6 +429,20 @@ class HorovodGlobalState:
             if self.timeline is not None:
                 self.timeline.close()
             self.shutdown_complete.set()
+
+    def _dump_flight_recorder(self, error: BaseException) -> None:
+        """Loop-death post-mortem: dump the flight-recorder ring + metrics
+        snapshot (+ held locks under lockdep) to the per-rank JSON.  Runs
+        after the abort broadcast — peers must hear the abort within one
+        poll quantum; the dump is for the human who arrives later."""
+        try:
+            path = flight_recorder.recorder.dump(
+                f"background loop death: {type(error).__name__}: {error}")
+            if path:
+                log.error("flight-recorder post-mortem written to %s", path)
+        except Exception as e:  # noqa: BLE001 — diagnostics must never
+            # mask the error being diagnosed
+            log.warning("flight-recorder dump failed: %s", e)
 
     def _broadcast_abort(self, error: BaseException) -> None:
         """Coordinated abort: tell every surviving peer WHY this rank's
@@ -389,15 +478,26 @@ class HorovodGlobalState:
 
         requests = self.tensor_queue.pop_messages()
         t0 = time.monotonic()
+        if self.timeline is not None:
+            # Tag this round's spans with the lockstep cycle id BEFORE
+            # negotiating — the same id names the same global round on
+            # every rank (trace_merge matches lanes on it).
+            self.timeline.set_cycle(self.cycle_count + 1)
         response_list = self.controller.compute_response_list(
             requests, self.shutdown_requested.is_set())
         self.cycle_count += 1
         self._last_cycle_had_work = bool(requests) \
             or bool(response_list.responses)
+        metrics.set_gauge("tensor_queue_depth", self.tensor_queue.size())
         if self._last_cycle_had_work:
             # Busy cycles only: timing idle lockstep parks would swamp the
             # negotiate lane with waiting, not negotiating.
-            phase_stats.add("negotiate", time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            phase_stats.add("negotiate", dt)
+            metrics.observe("controller_cycle_seconds", dt)
+            flight_recorder.record("cycle", n=self.cycle_count,
+                                   requests=len(requests),
+                                   responses=len(response_list.responses))
         if response_list.tuned_params is not None:
             # Autotuner moved (reference SynchronizeParameters): adopt the
             # broadcast cycle time on every rank.
@@ -405,6 +505,10 @@ class HorovodGlobalState:
         if self.timeline is not None:
             self.timeline.mark_cycle()
         for response in response_list.responses:
+            # The cycle this response was negotiated in (pipelined device
+            # dispatches execute under the NEXT cycle's negotiation, so
+            # the timeline/metrics must not read the live counter).
+            response._cycle = self.cycle_count
             if self.pipeline_dispatch and self._device_plane_response(response):
                 self._dispatch_async(response)
             else:
@@ -566,6 +670,7 @@ class HorovodGlobalState:
                 return
         if self.timeline is not None:
             self.timeline.op_start(response, entries)
+        t_op = time.monotonic()
         try:
             status = self.op_manager.execute(response, entries)
         except (PeerGoneError, CoordinatedAbortError) as e:
@@ -591,6 +696,9 @@ class HorovodGlobalState:
             # For async (pending) ops this marks dispatch end; completion
             # happens on the finalizer thread.
             self.timeline.op_end(response, entries)
+        if status.ok:
+            self._record_collective_latency(response,
+                                            time.monotonic() - t_op)
         if status.pending:
             # Async device work dispatched: a finalizer-pool worker waits
             # for readiness, so this loop moves straight on to the next
@@ -617,6 +725,35 @@ class HorovodGlobalState:
             return
         for e in entries:
             e.callback(status, e)
+
+    _TIMED_RESPONSES = (ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
+                        ResponseType.BROADCAST, ResponseType.ALLTOALL,
+                        ResponseType.ADASUM)
+
+    def _record_collective_latency(self, response: Response,
+                                   seconds: float) -> None:
+        """Per-collective latency histogram by op/dtype/size bucket.  For
+        host-plane ops this is dispatch-to-done; device-async ops record
+        the host dispatch cost (device completion belongs to the
+        finalizer) — the catalog documents the distinction."""
+        if not metrics.ENABLED \
+                or response.response_type not in self._TIMED_RESPONSES \
+                or response.tensor_type is None:
+            return
+        # _payload_bytes (coordinator-computed, controller.py) is the true
+        # byte count — ALLGATHER/ALLTOALL tensor_sizes are first dims /
+        # splits, not element counts.  The wire Response doesn't carry it,
+        # so worker ranks fall back to the flat-sum approximation (exact
+        # for ALLREDUCE/ADASUM/BROADCAST, a lower bound for the others —
+        # same compromise _fuse_responses makes).
+        nbytes = getattr(
+            response, "_payload_bytes",
+            sum(response.tensor_sizes) * response.tensor_type.itemsize)
+        metrics.observe(
+            "collective_latency_seconds", seconds,
+            op=response.response_type.name,
+            dtype=response.tensor_type.name,
+            size=metrics.size_bucket_label(nbytes))
 
     @staticmethod
     def _fire_callback(e, status) -> None:

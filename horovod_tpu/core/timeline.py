@@ -8,6 +8,17 @@ activities.  The reference feeds records through a boost lockfree spsc queue
 drained by a writer thread so the background loop never blocks on disk; we
 use a ``SimpleQueue`` + writer thread for the same property.
 
+Cross-rank story (the Dapper-shaped half, docs/observability.md): every
+rank writes its own trace with ``pid = rank`` (rank 0 at the configured
+``HOROVOD_TIMELINE`` path, rank r at ``<path>.rank<r>``), every span is
+tagged with its negotiation **cycle id** (the lockstep round counter,
+identical on every rank), and a ``clock_sync`` metadata record carries the
+wall-clock base plus an offset-to-the-rendezvous-server estimate
+(:func:`estimate_server_clock_offset_ns`, Cristian-style against the
+server's ``GET /clock``).  ``tools/trace_merge.py`` uses those to align
+the per-rank files into ONE Chrome/Perfetto view where every rank's lanes
+for the same collective line up.
+
 View the output in ``chrome://tracing`` / Perfetto.  Runtime toggles via
 ``hvd.start_timeline()/stop_timeline()`` (reference ``operations.cc:780-806``)
 or the ``HOROVOD_TIMELINE`` env knob.
@@ -21,17 +32,65 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..common import env as env_mod
+from . import metrics
+
 _WRITER_SENTINEL = None
+
+#: Name of the per-trace metadata record trace_merge aligns clocks on.
+CLOCK_SYNC_EVENT = "clock_sync"
+
+
+def rank_trace_path(path: str, rank: int) -> str:
+    """Per-rank trace file layout: rank 0 owns the configured path
+    (back-compat with single-file consumers), rank r writes
+    ``<path>.rank<r>``."""
+    return path if rank == 0 else f"{path}.rank{rank}"
+
+
+def estimate_server_clock_offset_ns(samples: int = 3) -> Optional[int]:
+    """Estimate this host's wall-clock offset to the rendezvous server
+    (``local_wall - server_wall``, ns) via the server's ``GET /clock``:
+    Cristian's algorithm, keeping the minimum-RTT sample.  Every rank
+    measures against the SAME server clock, so cross-rank skew is the
+    difference of these estimates.  Returns None when no rendezvous is
+    configured or unreachable — trace_merge then assumes synced clocks."""
+    import urllib.request
+
+    addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        return None
+    best = None  # (rtt_ns, offset_ns)
+    try:
+        for _ in range(samples):
+            t0 = time.time_ns()
+            with urllib.request.urlopen(
+                    f"http://{addr}:{port}/clock", timeout=2.0) as resp:
+                server_ns = int(resp.read())
+            t1 = time.time_ns()
+            cand = (t1 - t0, (t0 + t1) // 2 - server_ns)
+            if best is None or cand[0] < best[0]:
+                best = cand
+    except (OSError, ValueError):
+        return None if best is None else best[1]
+    return best[1]
 
 
 class Timeline:
-    def __init__(self, path: str, mark_cycles: bool = False):
+    def __init__(self, path: str, mark_cycles: bool = False, rank: int = 0,
+                 clock_offset_ns: Optional[int] = None):
         self._path = path
         self._mark_cycles = mark_cycles
+        self._pid = rank
+        self._cycle = 0
         self._queue: queue.SimpleQueue = queue.SimpleQueue()
         self._tids: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._start = time.monotonic_ns()
+        # Sampled back-to-back with _start: ts=0 on this trace's axis is
+        # this wall-clock instant (trace_merge's alignment anchor).
+        self._wall_base_ns = time.time_ns()
         self._closed = False
         self._file = open(path, "w", buffering=1024 * 1024)
         self._file.write("[\n")
@@ -39,13 +98,24 @@ class Timeline:
         self._writer = threading.Thread(
             target=self._writer_loop, name="horovod-timeline", daemon=True)
         self._writer.start()
-        self._emit({"name": "process_name", "ph": "M", "pid": 0,
-                    "args": {"name": "horovod_tpu background loop"}})
+        self._emit({"name": "process_name", "ph": "M", "pid": self._pid,
+                    "args": {"name": f"horovod_tpu rank {rank}"}})
+        self._emit({"name": CLOCK_SYNC_EVENT, "ph": "M", "pid": self._pid,
+                    "args": {"wall_base_ns": self._wall_base_ns,
+                             "server_offset_ns": clock_offset_ns,
+                             "rank": rank}})
 
     # -- producers (background/controller thread; never block) -------------
 
     def _ts_us(self) -> float:
         return (time.monotonic_ns() - self._start) / 1e3
+
+    def set_cycle(self, cycle: int) -> None:
+        """Current negotiation cycle id — the background loop advances it
+        each round.  Rounds are lockstep across ranks (the TCP recv pairs
+        them), so the same id names the same global round everywhere;
+        spans tagged with it line up across merged per-rank traces."""
+        self._cycle = cycle
 
     def _tid(self, tensor_name: str) -> int:
         with self._lock:
@@ -53,8 +123,9 @@ class Timeline:
             if tid is None:
                 tid = len(self._tids) + 1
                 self._tids[tensor_name] = tid
-                self._emit({"name": "thread_name", "ph": "M", "pid": 0,
-                            "tid": tid, "args": {"name": tensor_name}})
+                self._emit({"name": "thread_name", "ph": "M",
+                            "pid": self._pid, "tid": tid,
+                            "args": {"name": tensor_name}})
         return tid
 
     def _emit(self, record: dict) -> None:
@@ -62,43 +133,51 @@ class Timeline:
             self._queue.put(record)
 
     def negotiate_start(self, tensor_name: str, op_name: str) -> None:
-        self._emit({"name": f"NEGOTIATE_{op_name}", "ph": "B", "pid": 0,
-                    "tid": self._tid(tensor_name), "ts": self._ts_us()})
+        self._emit({"name": f"NEGOTIATE_{op_name}", "ph": "B",
+                    "pid": self._pid, "tid": self._tid(tensor_name),
+                    "ts": self._ts_us(), "args": {"cycle": self._cycle}})
 
     def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
         """Per-rank readiness tick inside the negotiation phase
         (reference ``NegotiateRankReady``, ``timeline.h:113``)."""
-        self._emit({"name": str(rank), "ph": "i", "s": "t", "pid": 0,
+        self._emit({"name": str(rank), "ph": "i", "s": "t", "pid": self._pid,
                     "tid": self._tid(tensor_name), "ts": self._ts_us()})
 
     def negotiate_end(self, tensor_name: str) -> None:
-        self._emit({"name": "", "ph": "E", "pid": 0,
+        self._emit({"name": "", "ph": "E", "pid": self._pid,
                     "tid": self._tid(tensor_name), "ts": self._ts_us()})
 
     def op_start(self, response, entries) -> None:
         name = response.response_type.name
         ts = self._ts_us()
+        # Pipelined device dispatches run while the NEXT cycle negotiates;
+        # the response carries the cycle it was negotiated in so the tag
+        # stays right regardless of which thread executes it.
+        cycle = getattr(response, "_cycle", self._cycle)
         for e in entries:
-            self._emit({"name": name, "ph": "B", "pid": 0,
-                        "tid": self._tid(e.tensor_name), "ts": ts})
+            self._emit({"name": name, "ph": "B", "pid": self._pid,
+                        "tid": self._tid(e.tensor_name), "ts": ts,
+                        "args": {"cycle": cycle}})
 
     def op_end(self, response, entries) -> None:
         ts = self._ts_us()
         for e in entries:
-            self._emit({"name": "", "ph": "E", "pid": 0,
+            self._emit({"name": "", "ph": "E", "pid": self._pid,
                         "tid": self._tid(e.tensor_name), "ts": ts})
 
     def activity(self, tensor_name: str, activity: str, begin: bool) -> None:
         """Nested activity markers (MEMCPY_IN_FUSION_BUFFER, ... —
         reference macro list ``common.h:31-62``)."""
         rec = {"name": activity if begin else "", "ph": "B" if begin else "E",
-               "pid": 0, "tid": self._tid(tensor_name), "ts": self._ts_us()}
+               "pid": self._pid, "tid": self._tid(tensor_name),
+               "ts": self._ts_us()}
         self._emit(rec)
 
     def mark_cycle(self) -> None:
         if self._mark_cycles:
-            self._emit({"name": "CYCLE", "ph": "i", "s": "g", "pid": 0,
-                        "tid": 0, "ts": self._ts_us()})
+            self._emit({"name": "CYCLE", "ph": "i", "s": "g",
+                        "pid": self._pid, "tid": 0, "ts": self._ts_us(),
+                        "args": {"cycle": self._cycle}})
 
     # -- writer thread ------------------------------------------------------
 
@@ -147,7 +226,8 @@ class PhaseStats:
     millisecond budget go" cheaply enough to leave enabled (a few monotonic
     reads + one dict update per phase per response).  Surfaced by
     ``benchmarks/eager_bench.py --profile`` / ``eager_np_bench.py
-    --profile`` and snapshot-able from tests."""
+    --profile``, snapshot-able from tests, and registered as a view in the
+    metrics registry (``phase_seconds_total``/``phase_ops_total``)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -204,7 +284,8 @@ class CounterStats:
       nothing is ever ``tobytes()``'d or ``frombuffer``-copied).
 
     Cheap enough to leave always-on (one dict update under a lock per
-    event; the transport batches per frame, not per syscall)."""
+    event; the transport batches per frame, not per syscall).  Registered
+    as a metrics-registry view (``wire_*_total``)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -230,3 +311,26 @@ class CounterStats:
 #: Process-global data-plane counters (bytes_on_wire, heap_copies);
 #: surfaced by the benches' ``--profile`` output next to ``phase_stats``.
 wire_stats = CounterStats()
+
+
+# -- registry views: fold the pre-existing accumulators into every
+#    metrics snapshot (docs/observability.md) -------------------------------
+
+
+def _phase_stats_view() -> dict:
+    counters: Dict[str, float] = {}
+    for phase, d in phase_stats.snapshot().items():
+        counters[metrics.flat("phase_seconds_total", phase=phase)] = \
+            d["total_ms"] / 1e3
+        counters[metrics.flat("phase_ops_total", phase=phase)] = d["count"]
+    return {"counters": counters}
+
+
+def _wire_stats_view() -> dict:
+    return {"counters": {
+        f"wire_{name}_total": value
+        for name, value in wire_stats.snapshot().items()}}
+
+
+metrics.registry.register_view("phase_stats", _phase_stats_view)
+metrics.registry.register_view("wire_stats", _wire_stats_view)

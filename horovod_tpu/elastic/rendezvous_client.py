@@ -51,6 +51,10 @@ def request_reset(reason: str) -> bool:
     payload = json.dumps({"epoch": env_mod.get_epoch(),
                           "reason": reason[:512]}).encode()
     try:
+        from ..core import flight_recorder
+
+        flight_recorder.record("reset_request", epoch=env_mod.get_epoch(),
+                               reason=reason[:300])
         HTTPStoreClient(addr, port).set(
             RESET_REQUEST_SCOPE, _identity(), payload)
         return True
@@ -115,6 +119,12 @@ def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
                      ("cross_size", env_mod.HOROVOD_CROSS_SIZE)]:
         os.environ[var] = str(slot[key])
     os.environ[env_mod.HOROVOD_EPOCH] = str(slot["epoch"])
+    from ..core import flight_recorder, metrics
+
+    metrics.inc("elastic_epoch_changes_total")
+    metrics.set_gauge("elastic_epoch", slot["epoch"])
+    flight_recorder.record("epoch_change", epoch=slot["epoch"],
+                           rank=slot["rank"], size=slot["size"])
     return ProcessTopology(
         rank=slot["rank"], size=slot["size"],
         local_rank=slot["local_rank"], local_size=slot["local_size"],

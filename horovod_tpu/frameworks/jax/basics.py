@@ -166,18 +166,26 @@ def is_homogeneous() -> bool:
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
     """Runtime-togglable timeline (reference ``operations.cc:780-806``).
 
-    Like the env-var path, the trace is written only on the coordinator
-    (rank 0, reference ``operations.cc:424-432``); on other ranks this is a
-    no-op so ranks sharing a filesystem don't clobber one file."""
-    from ...core.timeline import Timeline
+    Like the ``HOROVOD_TIMELINE`` env path, EVERY rank writes its own
+    trace with ``pid = rank`` — rank 0 at ``file_path``, rank r at
+    ``file_path.rank<r>`` so ranks sharing a filesystem never clobber one
+    file — and ``tools/trace_merge.py`` folds them into one cross-rank
+    view.  The coordinator-side negotiation lanes exist only on rank 0
+    (the message table lives there, reference ``operations.cc:424-432``)."""
+    from ...core.timeline import (
+        Timeline,
+        estimate_server_clock_offset_ns,
+        rank_trace_path,
+    )
 
     state = global_state()
-    if state.topo is not None and state.topo.rank != 0:
-        return
+    rank = state.topo.rank if state.topo is not None else 0
     if state.timeline is not None:
         state.timeline.close()
-    state.timeline = Timeline(file_path, mark_cycles=mark_cycles)
-    if state.controller is not None:
+    state.timeline = Timeline(
+        rank_trace_path(file_path, rank), mark_cycles=mark_cycles,
+        rank=rank, clock_offset_ns=estimate_server_clock_offset_ns())
+    if state.controller is not None and rank == 0:
         state.controller.timeline = state.timeline
 
 

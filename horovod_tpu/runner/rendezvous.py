@@ -6,15 +6,28 @@ starts before spawning workers.  Workers publish/fetch TCP endpoints through
 it (``transport.store.HTTPStoreClient``), the elastic driver publishes slot
 assignments into a well-known scope, and DELETE doubles as the
 worker-finalized notification hook.
+
+Observability additions (docs/observability.md): workers push metrics
+snapshots into the ``metrics`` scope (``PUT /metrics/rank-N``), and two
+special GET paths serve the cluster view — ``GET /metrics`` renders the
+cross-rank aggregate in Prometheus text format (histograms merged, gauges
+labeled by rank; append ``?format=json`` for the raw per-rank snapshots),
+``GET /clock`` returns the server's wall clock in ns (the timestamp-
+exchange anchor ``tools/trace_merge.py``'s clock alignment relies on).
+Both are unauthenticated read-only endpoints by design: a Prometheus
+scraper can't sign requests, and neither path can mutate the store.
 """
 
 from __future__ import annotations
 
+import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Tuple
 from urllib.parse import unquote
 
+from ..core import metrics as metrics_mod
 from ..transport.store import MemoryStore
 
 RANK_AND_SIZE_SCOPE = "rank_and_size"
@@ -63,7 +76,52 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def _reply(self, body: bytes, content_type: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _serve_special_get(self) -> bool:
+        """Read-only observability endpoints (no /scope/key shape, no
+        HMAC — see the module docstring): GET /clock and GET /metrics."""
+        path, _, query = self.path.partition("?")
+        if path == "/clock":
+            self._reply(str(time.time_ns()).encode(), "text/plain")
+            return True
+        if path == "/metrics":
+            snaps = {}
+            for key in self.server.store_keys(metrics_mod.METRICS_SCOPE):
+                raw = self.server.store_get(metrics_mod.METRICS_SCOPE, key)
+                if raw is None:
+                    continue
+                try:
+                    snaps[key] = json.loads(raw)
+                except ValueError:
+                    continue  # half-written push: skip this rank's sample
+            # Elastic staleness gate: after a re-rendezvous, a departed
+            # rank's final snapshot (stamped with the OLD epoch) would be
+            # served forever — frozen gauges, dead counters summed into
+            # cluster totals.  Serve only the newest epoch present.
+            epochs = [s.get("epoch", 0) for s in snaps.values()
+                      if isinstance(s, dict)]
+            if epochs:
+                newest = max(epochs)
+                snaps = {k: s for k, s in snaps.items()
+                         if not isinstance(s, dict)
+                         or s.get("epoch", 0) == newest}
+            if "format=json" in query:
+                self._reply(json.dumps(snaps).encode(), "application/json")
+            else:
+                self._reply(metrics_mod.render_prometheus(snaps).encode(),
+                            "text/plain; version=0.0.4")
+            return True
+        return False
+
     def do_GET(self):
+        if self._serve_special_get():
+            return
         parsed = self._parse()
         if parsed is None:
             return

@@ -23,6 +23,8 @@ HVD003      fault site not in ``faults.SITES`` / undocumented site
 HVD004      swallowed exception in a thread-target/daemon-loop body
 HVD005      control-frame wire-tag invariants in ``core/messages.py``
 HVD006      anonymous thread (``threading.Thread`` without ``name=``)
+HVD007      metric name not in ``core/metrics.py`` ``CATALOG`` /
+            undocumented metric
 ==========  ===========================================================
 
 Suppressions: a violation is silenced by a comment on its line (or on a
@@ -98,6 +100,8 @@ class Project:
         self.root = root or _find_package_root()
         self._sites: Optional[Tuple[str, ...]] = None
         self._fault_doc: Optional[str] = None
+        self._metric_catalog: Optional[Tuple[str, ...]] = None
+        self._metrics_doc: Optional[str] = None
 
     @property
     def fault_sites(self) -> Tuple[str, ...]:
@@ -129,13 +133,51 @@ class Project:
     @property
     def fault_doc(self) -> str:
         if self._fault_doc is None:
-            path = os.path.join(self.root, "docs", "fault_injection.md")
-            try:
-                with open(path, encoding="utf-8") as f:
-                    self._fault_doc = f.read()
-            except OSError:
-                self._fault_doc = ""
+            self._fault_doc = self._read_doc("fault_injection.md")
         return self._fault_doc
+
+    @property
+    def metric_catalog(self) -> Tuple[str, ...]:
+        """``CATALOG`` keys parsed from the AST of core/metrics.py —
+        parsed, not imported, like :attr:`fault_sites` (duplicate dict
+        keys survive the parse, so HVD007 can flag them)."""
+        if self._metric_catalog is None:
+            self._metric_catalog = self._parse_metric_catalog()
+        return self._metric_catalog
+
+    def _parse_metric_catalog(self) -> Tuple[str, ...]:
+        path = os.path.join(self.root, "horovod_tpu", "core", "metrics.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return ()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "CATALOG" \
+                            and isinstance(node.value, ast.Dict):
+                        return tuple(
+                            k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str))
+        return ()
+
+    @property
+    def metrics_doc(self) -> str:
+        if self._metrics_doc is None:
+            self._metrics_doc = self._read_doc("observability.md")
+        return self._metrics_doc
+
+    def _read_doc(self, name: str) -> str:
+        path = os.path.join(self.root, "docs", name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return ""
 
 
 def _find_package_root() -> str:

@@ -678,6 +678,78 @@ class AnonymousThread(Rule):
             "Thread-N")
 
 
+# ---------------------------------------------------------------------------
+# HVD007 — metric names must come from (and be documented in) the catalog
+# ---------------------------------------------------------------------------
+
+class MetricCatalogRule(Rule):
+    """``metrics.inc("collectve_latency...")`` with a typo'd name records
+    into a series nobody reads — dashboards and the overhead guard pass
+    vacuously, the exact silent failure HVD003 closes for fault sites.
+    Every name fed to ``metrics.inc``/``set_gauge``/``observe`` (and to
+    the ``phase_stats``/``wire_stats`` ``add`` accumulators the registry
+    absorbs as views) must be a literal found in ``core/metrics.py``'s
+    ``CATALOG``, and every catalog entry must appear in
+    ``docs/observability.md`` so operators can discover it."""
+
+    code = "HVD007"
+    title = "metric name not in metrics CATALOG / undocumented metric"
+
+    _REG_FUNCS = frozenset({"inc", "set_gauge", "observe"})
+    _REG_RECEIVERS = frozenset({"metrics", "registry"})
+    _STATS_RECEIVERS = frozenset({"wire_stats", "phase_stats"})
+
+    def check(self, ctx, project):
+        is_registry = ctx.rel_path.endswith("core/metrics.py")
+        names = project.metric_catalog
+        if is_registry:
+            yield from self._check_registry(ctx, names, project)
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            fname = _terminal_name(func)
+            if not isinstance(func, ast.Attribute):
+                continue
+            recv = _terminal_name(func.value)
+            if fname in self._REG_FUNCS and recv in self._REG_RECEIVERS:
+                pass
+            elif fname == "add" and recv in self._STATS_RECEIVERS:
+                pass
+            else:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if names and arg.value not in names:
+                    yield self._v(
+                        ctx, node,
+                        f"metric name {arg.value!r} is not declared in "
+                        "core/metrics.py CATALOG; a typo'd name records "
+                        "into a series nobody reads")
+            else:
+                yield self._v(
+                    ctx, node,
+                    "metric name must be a string literal from the "
+                    "core/metrics.py CATALOG (a computed name defeats "
+                    "static verification)")
+
+    def _check_registry(self, ctx, names, project) -> Iterator[Violation]:
+        doc = project.metrics_doc
+        seen: Set[str] = set()
+        for name in names:
+            if name in seen:
+                yield Violation(self.code, ctx.path, 1, 0,
+                                f"duplicate CATALOG entry {name!r}")
+            seen.add(name)
+            if doc and f"`{name}`" not in doc:
+                yield Violation(
+                    self.code, ctx.path, 1, 0,
+                    f"cataloged metric {name!r} is missing from "
+                    "docs/observability.md (the catalog table is the "
+                    "operator-facing registry mirror)")
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     BlockingUnderLock(),
     EnvLiteralOutsideRegistry(),
@@ -685,6 +757,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     SwallowedThreadException(),
     WireTagInvariants(),
     AnonymousThread(),
+    MetricCatalogRule(),
 )
 
 RULE_CODES = frozenset(r.code for r in ALL_RULES) | {"HVD000"}

@@ -1,0 +1,103 @@
+"""One-shot metrics snapshot of a LIVE job via the rendezvous server.
+
+The workers push registry snapshots to the rendezvous KV every
+``HOROVOD_METRICS_PUSH_SECS`` (core/state.py); the server aggregates them
+at ``GET /metrics`` (runner/rendezvous.py).  This tool is the operator's
+curl-with-a-brain: fetch the scrape, either raw (Prometheus text, exactly
+what a Prometheus scraper would ingest) or pretty-printed per rank.
+
+Usage::
+
+    python -m horovod_tpu.tools.metrics_dump              # addr from env
+    python -m horovod_tpu.tools.metrics_dump --addr 10.0.0.2 --port 41999
+    python -m horovod_tpu.tools.metrics_dump --raw        # Prometheus text
+    tools/metrics_dump.py --json                          # raw snapshots
+
+Address defaults come from the launcher-propagated
+``HOROVOD_GLOO_RENDEZVOUS_ADDR``/``PORT`` env, so running it on any job
+host with the job's environment just works.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Optional, Sequence
+
+from ..common import env as env_mod
+
+
+def fetch(addr: str, port: int, fmt: str = "text",
+          timeout: float = 5.0) -> str:
+    suffix = "?format=json" if fmt == "json" else ""
+    with urllib.request.urlopen(
+            f"http://{addr}:{port}/metrics{suffix}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _pretty(snaps: dict) -> str:
+    out = []
+    for key in sorted(snaps, key=str):
+        snap = snaps[key]
+        rank = snap.get("rank", key)
+        out.append(f"== rank {rank} (pushed at unix_ns="
+                   f"{snap.get('ts_unix_ns', '?')}) ==")
+        for kind in ("counters", "gauges"):
+            for name in sorted(snap.get(kind, {})):
+                out.append(f"  {name} = {snap[kind][name]}")
+        for name in sorted(snap.get("histograms", {})):
+            h = snap["histograms"][name]
+            n = max(1, h.get("count", 0))
+            out.append(f"  {name}: count={h.get('count', 0)} "
+                       f"sum={h.get('sum', 0.0):.6g} "
+                       f"mean={h.get('sum', 0.0) / n:.6g}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="metrics-dump",
+        description="one-shot cross-rank metrics snapshot of a live "
+                    "horovod_tpu job (docs/observability.md)")
+    ap.add_argument("--addr", default=None,
+                    help="rendezvous server address (default: "
+                         "HOROVOD_GLOO_RENDEZVOUS_ADDR)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="rendezvous server port (default: "
+                         "HOROVOD_GLOO_RENDEZVOUS_PORT)")
+    ap.add_argument("--raw", action="store_true",
+                    help="print the Prometheus text scrape verbatim")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw per-rank snapshot JSON")
+    args = ap.parse_args(argv)
+
+    addr = args.addr or env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = args.port or env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        print("metrics-dump: no rendezvous server (pass --addr/--port or "
+              "run inside a job's environment)", file=sys.stderr)
+        return 2
+    try:
+        if args.raw:
+            print(fetch(addr, port, "text"), end="")
+        elif args.json:
+            print(fetch(addr, port, "json"))
+        else:
+            snaps = json.loads(fetch(addr, port, "json"))
+            if not snaps:
+                print("metrics-dump: no rank has pushed a snapshot yet "
+                      "(HOROVOD_METRICS_PUSH_SECS=0, or the job just "
+                      "started)")
+            else:
+                print(_pretty(snaps))
+    except OSError as e:
+        print(f"metrics-dump: scrape of {addr}:{port} failed: {e}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,14 +140,19 @@ class HTTPStoreClient(Store):
         raise last
 
     def set(self, scope: str, key: str, value: bytes) -> None:
+        from ..core import metrics
+
+        metrics.inc("rendezvous_store_ops_total", op="set")
         with self._open_with_retry(self._request(scope, key, "PUT", value)):
             pass
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         from ..common import faults
+        from ..core import metrics
 
         if faults.ACTIVE:
             faults.inject("rendezvous.get")
+        metrics.inc("rendezvous_store_ops_total", op="get")
         try:
             with self._open_with_retry(
                     self._request(scope, key, "GET")) as resp:
@@ -158,6 +163,9 @@ class HTTPStoreClient(Store):
             raise
 
     def delete(self, scope: str, key: str) -> None:
+        from ..core import metrics
+
+        metrics.inc("rendezvous_store_ops_total", op="delete")
         req = self._request(scope, key, "DELETE")
         try:
             with urllib.request.urlopen(req, timeout=self._timeout):

@@ -275,6 +275,43 @@ def test_corrupt_frame_np2_coordinated_abort():
 
 
 @pytest.mark.timeout(150)
+def test_corrupt_abort_writes_flight_recorder_dump_on_every_rank(tmp_path):
+    """The flight recorder's contract (docs/observability.md): an injected
+    mid-train corruption abort leaves a parseable post-mortem JSON on
+    EVERY rank — the detector (CRC failure) and the survivor (coordinated
+    abort) alike — naming the reason and carrying the recent-event ring
+    plus a metrics snapshot.  The injecting rank's ring must contain the
+    fired fault itself (recorded before the action ran)."""
+    import json
+
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_FLIGHT_RECORDER_DIR": str(tmp_path),
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=6:action=corrupt,1"})
+    for r in range(2):
+        assert f"SURVIVOR_ABORT {r}" in outs[r], (r, outs[r])
+        dump = tmp_path / f"hvd_flight_recorder.rank{r}.json"
+        assert dump.exists(), (r, outs[r])
+        doc = json.loads(dump.read_text())  # parseable on every rank
+        assert doc["rank"] == r
+        assert "background loop death" in doc["reason"], doc["reason"]
+        assert doc["events"], "flight-recorder ring was empty"
+        kinds = {e["kind"] for e in doc["events"]}
+        assert "frame" in kinds, kinds
+        assert doc["metrics"] and "counters" in doc["metrics"]
+    # the detector's dump names the CRC failure; the injector's ring
+    # recorded its own fired fault clause
+    doc0 = json.loads((tmp_path / "hvd_flight_recorder.rank0.json")
+                      .read_text())
+    assert "wire CRC" in doc0["reason"] or "FrameCorrupt" in doc0["reason"]
+    doc1 = json.loads((tmp_path / "hvd_flight_recorder.rank1.json")
+                      .read_text())
+    assert "fault" in {e["kind"] for e in doc1["events"]}, doc1["events"]
+
+
+@pytest.mark.timeout(150)
 def test_truncated_frame_np2_typed_abort():
     """A misframed (short) application frame passes the wire CRC by
     construction and must be caught by the defensive parse layer as a

@@ -564,6 +564,103 @@ def test_hvd006_executor_needs_name_prefix():
 
 
 # ---------------------------------------------------------------------------
+# HVD007 — metric names must come from (and be documented in) the catalog
+# ---------------------------------------------------------------------------
+
+HVD007_VIOLATING = """
+    from horovod_tpu.core import metrics
+    def f():
+        metrics.inc("nonexistent_metric_total")
+"""
+
+HVD007_CLEAN = """
+    from horovod_tpu.core import metrics
+    from horovod_tpu.core.timeline import phase_stats, wire_stats
+    def f(dt):
+        metrics.inc("faults_injected_total")
+        metrics.set_gauge("tensor_queue_depth", 3)
+        metrics.observe("collective_latency_seconds", dt, op="ALLREDUCE")
+        wire_stats.add("bytes_on_wire", 128)
+        phase_stats.add("negotiate", dt)
+        unrelated.observe("whatever")  # not the metrics receiver
+"""
+
+HVD007_SUPPRESSED = """
+    from horovod_tpu.core import metrics
+    def f():
+        metrics.inc("nonexistent_metric_total")  # hvdlint: disable=HVD007 -- fixture: testing the suppression path
+"""
+
+
+def test_hvd007_catalog_is_populated():
+    names = PROJECT.metric_catalog
+    assert "collective_latency_seconds" in names
+    assert "bytes_on_wire" in names     # wire_stats literal
+    assert "negotiate" in names         # phase_stats literal
+
+
+def test_hvd007_unknown_metric():
+    vs = run(HVD007_VIOLATING)
+    assert codes(vs) == ["HVD007"]
+    assert "nonexistent_metric_total" in vs[0].message
+
+
+def test_hvd007_stats_add_checked_too():
+    vs = run("""
+        from horovod_tpu.core.timeline import wire_stats
+        def f():
+            wire_stats.add("bytes_on_wrie", 4)
+    """)
+    assert codes(vs) == ["HVD007"]
+
+
+def test_hvd007_computed_name_rejected():
+    vs = run("""
+        from horovod_tpu.core import metrics
+        def f(name):
+            metrics.inc(name)
+    """)
+    assert codes(vs) == ["HVD007"]
+    assert "literal" in vs[0].message
+
+
+def test_hvd007_clean():
+    assert run(HVD007_CLEAN) == []
+
+
+def test_hvd007_suppressed():
+    assert run(HVD007_SUPPRESSED) == []
+
+
+def test_hvd007_every_metric_documented():
+    """The real registry file must pass (every CATALOG entry backticked
+    in docs/observability.md) — the HVD003 doc-mirror contract, metrics
+    flavor.  Checked via the real file so a catalog addition without its
+    doc row fails here by name."""
+    path = os.path.join(PKG, "core", "metrics.py")
+    vs = lint_paths([path], PROJECT)
+    assert [v for v in vs if v.code == "HVD007"] == [], vs
+
+
+def test_hvd007_undocumented_metric_detected(tmp_path):
+    """A catalog entry absent from the doc must be flagged — proven with
+    a shadow project root whose doc is empty-ish but whose registry has
+    one extra name."""
+    shadow = tmp_path / "root"
+    (shadow / "horovod_tpu" / "core").mkdir(parents=True)
+    (shadow / "docs").mkdir()
+    (shadow / "horovod_tpu" / "core" / "metrics.py").write_text(
+        'CATALOG = {"documented_total": ("counter", "x"),\n'
+        '           "undocumented_total": ("counter", "y")}\n')
+    (shadow / "docs" / "observability.md").write_text(
+        "only `documented_total` appears here\n")
+    vs = lint_paths([str(shadow / "horovod_tpu" / "core" / "metrics.py")],
+                    Project(root=str(shadow)))
+    assert codes(vs) == ["HVD007"]
+    assert "undocumented_total" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
 # HVD000 — suppression hygiene
 # ---------------------------------------------------------------------------
 
@@ -623,6 +720,7 @@ def test_no_anonymous_threads_in_tree(tree_violations):
     ("HVD003", HVD003_VIOLATING),
     ("HVD004", HVD004_VIOLATING),
     ("HVD006", HVD006_VIOLATING),
+    ("HVD007", HVD007_VIOLATING),
 ])
 def test_seeded_violation_fails_with_right_code(tmp_path, code, fixture):
     """Seeding any single violation into a linted tree must fail the pass
@@ -647,4 +745,4 @@ def test_cli_exit_codes(tmp_path, capsys):
 
 def test_rule_codes_catalog():
     assert RULE_CODES == {"HVD000", "HVD001", "HVD002", "HVD003",
-                          "HVD004", "HVD005", "HVD006"}
+                          "HVD004", "HVD005", "HVD006", "HVD007"}

@@ -1,0 +1,517 @@
+"""Observability plane: metrics registry + /metrics scrape, cross-rank
+merged timeline, flight-recorder post-mortems (docs/observability.md).
+
+Fast unit tiers first (registry semantics, Prometheus rendering, flight
+ring, trace alignment, stall-inspector surfacing, runtime timeline
+toggles); the np=2 end-to-end proofs — a live ``GET /metrics`` scrape
+with cross-rank latency histograms, and a merged two-rank trace where
+both ranks' lanes share a cycle id — are chaos-marked so they sort after
+the fast tiers (tier-1 budget rule: heavy multiprocess jobs run late).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import flight_recorder, metrics
+
+from .helpers import run_distributed
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Registry/ring state must not leak between tests."""
+    metrics.registry.reset()
+    flight_recorder.recorder.clear()
+    yield
+    metrics.configure(None)
+    metrics.registry.reset()
+    flight_recorder.recorder.clear()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestRegistry:
+    def test_counter_accumulates(self):
+        metrics.inc("faults_injected_total")
+        metrics.inc("faults_injected_total", 2)
+        assert metrics.registry.get_counter("faults_injected_total") == 3
+
+    def test_gauge_overwrites(self):
+        metrics.set_gauge("tensor_queue_depth", 5)
+        metrics.set_gauge("tensor_queue_depth", 2)
+        assert metrics.registry.get_gauge("tensor_queue_depth") == 2
+
+    def test_labels_partition_series(self):
+        metrics.inc("rendezvous_store_ops_total", op="get")
+        metrics.inc("rendezvous_store_ops_total", op="get")
+        metrics.inc("rendezvous_store_ops_total", op="set")
+        assert metrics.registry.get_counter(
+            "rendezvous_store_ops_total", op="get") == 2
+        assert metrics.registry.get_counter(
+            "rendezvous_store_ops_total", op="set") == 1
+
+    def test_histogram_buckets_and_sum(self):
+        for v in (1e-5, 1e-5, 0.5, 1e9):  # last lands in overflow
+            metrics.observe("controller_cycle_seconds", v)
+        snap = metrics.registry.snapshot()
+        h = snap["histograms"]["controller_cycle_seconds"]
+        assert h["count"] == 4
+        assert h["sum"] == pytest.approx(1e9 + 0.5 + 2e-5)
+        assert len(h["counts"]) == len(metrics.BUCKET_BOUNDS) + 1
+        assert sum(h["counts"]) == 4
+        assert h["counts"][-1] == 1  # the +Inf overflow observation
+
+    def test_disabled_is_a_noop(self):
+        metrics.configure(False)
+        try:
+            metrics.inc("faults_injected_total")
+            metrics.observe("controller_cycle_seconds", 1.0)
+            metrics.set_gauge("tensor_queue_depth", 9)
+        finally:
+            metrics.configure(True)
+        snap = metrics.registry.snapshot()
+        assert "faults_injected_total" not in snap["counters"]
+        assert "tensor_queue_depth" not in snap["gauges"]
+        assert "controller_cycle_seconds" not in snap["histograms"]
+
+    def test_flat_roundtrip(self):
+        flat = metrics.flat("x_total", op="GET", rank="3")
+        assert flat == 'x_total{op="GET",rank="3"}'
+        base, labels = metrics.parse_flat(flat)
+        assert base == "x_total" and labels == {"op": "GET", "rank": "3"}
+        assert metrics.parse_flat("plain") == ("plain", {})
+
+    def test_flat_rejects_quotes_in_values(self):
+        with pytest.raises(ValueError):
+            metrics.flat("x", op='a"b')
+
+    def test_size_bucket_label(self):
+        assert metrics.size_bucket_label(1) == "2^0"
+        assert metrics.size_bucket_label(1024) == "2^10"
+        assert metrics.size_bucket_label(1025) == "2^11"
+        assert metrics.size_bucket_label(4 << 20) == "2^22"
+
+    def test_views_fold_into_snapshot_and_replace(self):
+        metrics.registry.register_view(
+            "t", lambda: {"counters": {"phase_ops_total": 7}})
+        assert metrics.registry.snapshot()["counters"][
+            "phase_ops_total"] == 7
+        metrics.registry.register_view(
+            "t", lambda: {"counters": {"phase_ops_total": 9}})
+        assert metrics.registry.snapshot()["counters"][
+            "phase_ops_total"] == 9
+
+    def test_broken_view_does_not_break_snapshot(self):
+        def bad():
+            raise RuntimeError("boom")
+
+        metrics.registry.register_view("bad", bad)
+        metrics.inc("faults_injected_total")
+        assert metrics.registry.snapshot()["counters"][
+            "faults_injected_total"] == 1
+
+    def test_wire_and_phase_stats_are_registered_views(self):
+        from horovod_tpu.core.timeline import phase_stats, wire_stats
+
+        wire_stats.add("bytes_on_wire", 128)
+        phase_stats.add("negotiate", 0.25)
+        snap = metrics.registry.snapshot()
+        assert snap["counters"]["wire_bytes_on_wire_total"] >= 128
+        key = metrics.flat("phase_seconds_total", phase="negotiate")
+        assert snap["counters"][key] >= 0.25
+
+    def test_catalog_covers_every_stat_literal(self):
+        # The names the codebase feeds to phase_stats/wire_stats.add —
+        # HVD007's contract, restated where a registry edit breaks it.
+        for name in ("negotiate", "fuse", "collective", "unfuse", "wait",
+                     "bytes_on_wire", "heap_copies"):
+            assert name in metrics.CATALOG
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering / cross-rank merge
+# ---------------------------------------------------------------------------
+
+
+def _snap(rank, counters=None, gauges=None, histograms=None):
+    return {"version": 1, "rank": rank, "ts_unix_ns": 0,
+            "bucket_bounds": list(metrics.BUCKET_BOUNDS),
+            "counters": counters or {}, "gauges": gauges or {},
+            "histograms": histograms or {}}
+
+
+@pytest.mark.smoke
+class TestPrometheusRender:
+    def test_counters_sum_across_ranks(self):
+        text = metrics.render_prometheus({
+            0: _snap(0, counters={"aborts_total": 2}),
+            1: _snap(1, counters={"aborts_total": 3})})
+        assert "hvd_aborts_total 5" in text
+        assert "# TYPE hvd_aborts_total counter" in text
+
+    def test_gauges_labeled_by_rank(self):
+        text = metrics.render_prometheus({
+            0: _snap(0, gauges={"tensor_queue_depth": 1}),
+            1: _snap(1, gauges={"tensor_queue_depth": 4})})
+        assert 'hvd_tensor_queue_depth{rank="0"} 1' in text
+        assert 'hvd_tensor_queue_depth{rank="1"} 4' in text
+
+    def test_histograms_merge_cumulatively(self):
+        counts = [0] * (len(metrics.BUCKET_BOUNDS) + 1)
+        counts[0] = 1
+        h0 = {"collective_latency_seconds": {
+            "counts": list(counts), "sum": 0.5, "count": 1}}
+        counts2 = list(counts)
+        counts2[-1] = 2  # overflow bucket on rank 1
+        h1 = {"collective_latency_seconds": {
+            "counts": counts2, "sum": 1.5, "count": 3}}
+        text = metrics.render_prometheus({0: _snap(0, histograms=h0),
+                                          1: _snap(1, histograms=h1)})
+        assert 'hvd_collective_latency_seconds_bucket{le="+Inf"} 4' in text
+        assert "hvd_collective_latency_seconds_sum 2" in text
+        assert "hvd_collective_latency_seconds_count 4" in text
+        # cumulative: every bucket line's value is non-decreasing
+        vals = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+                if line.startswith("hvd_collective_latency_seconds_bucket")]
+        assert vals == sorted(vals)
+
+    def test_malformed_snapshot_is_skipped(self):
+        text = metrics.render_prometheus({
+            0: _snap(0, counters={"aborts_total": 1}), 1: "garbage"})
+        assert "hvd_aborts_total 1" in text
+
+
+@pytest.mark.smoke
+def test_scrape_serves_only_newest_epoch():
+    """Elastic staleness gate: a departed rank's last snapshot (stamped
+    with the old epoch) must drop out of the scrape once survivors push
+    under the new epoch."""
+    import urllib.request
+
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    server = RendezvousServer(bind_addr="127.0.0.1")
+    port = server.start()
+    try:
+        old = _snap(3, gauges={"tensor_queue_depth": 9})
+        old["epoch"] = 0
+        new = _snap(0, gauges={"tensor_queue_depth": 1})
+        new["epoch"] = 1
+        server.set(metrics.METRICS_SCOPE, "rank-3",
+                   json.dumps(old).encode())
+        server.set(metrics.METRICS_SCOPE, "rank-0",
+                   json.dumps(new).encode())
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert 'hvd_tensor_queue_depth{rank="0"} 1' in text
+        assert 'rank="3"' not in text, text
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER_EVENTS", "8")
+        rec = flight_recorder.FlightRecorder()
+        for i in range(50):
+            rec.record("frame", n=i)
+        events = rec.events()
+        assert len(events) == 8
+        assert [e["n"] for e in events] == list(range(42, 50))
+
+    def test_dump_is_parseable_and_complete(self, tmp_path):
+        flight_recorder.record("cycle", n=3)
+        flight_recorder.record("fault", site="tcp.send")
+        metrics.inc("faults_injected_total")
+        path = flight_recorder.recorder.dump(
+            "unit test", path=str(tmp_path / "dump.json"))
+        doc = json.loads((tmp_path / "dump.json").read_text())
+        assert path == str(tmp_path / "dump.json")
+        assert doc["format"] == flight_recorder.DUMP_FORMAT
+        assert doc["reason"] == "unit test"
+        assert {e["kind"] for e in doc["events"]} == {"cycle", "fault"}
+        assert doc["metrics"]["counters"]["faults_injected_total"] == 1
+        for e in doc["events"]:
+            assert "t_mono" in e and "t_wall" in e and "thread" in e
+
+    def test_dump_dir_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER_DIR", str(tmp_path))
+        monkeypatch.setenv("HOROVOD_RANK", "7")
+        flight_recorder.record("cycle", n=1)
+        path = flight_recorder.recorder.dump("dir knob")
+        assert path == str(tmp_path / "hvd_flight_recorder.rank7.json")
+        assert json.loads(open(path).read())["rank"] == 7
+
+    def test_disabled_records_and_dumps_nothing(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", "0")
+        rec = flight_recorder.FlightRecorder()
+        rec.record("frame")
+        assert rec.events() == []
+        assert rec.dump("off", path=str(tmp_path / "no.json")) is None
+        assert not (tmp_path / "no.json").exists()
+
+    def test_dump_never_raises_on_bad_path(self):
+        assert flight_recorder.recorder.dump(
+            "bad", path="/nonexistent-dir-xyz/d.json") is None
+
+
+# ---------------------------------------------------------------------------
+# stall inspector -> metrics surfacing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestStallMetrics:
+    def _controller(self, warn=0.01, shut=0.0):
+        from horovod_tpu.common.topology import ProcessTopology
+        from horovod_tpu.core.controller import Controller
+
+        topo = ProcessTopology(rank=0, size=2, local_rank=0, local_size=2)
+        c = Controller(topo, mesh=None, stall_warning_secs=warn,
+                       stall_shutdown_secs=shut)
+        c._last_stall_check = 0.0  # force the next check to run
+        return c
+
+    def _stall_tensor(self, c, name="stuck", age=10.0):
+        from horovod_tpu.core.controller import _TableEntry
+
+        entry = _TableEntry()
+        entry.ranks.add(0)
+        entry.first_seen = time.monotonic() - age
+        c._message_table[name] = entry
+
+    def test_stalled_gauge_counts_overdue_tensors(self):
+        c = self._controller(warn=0.01)
+        self._stall_tensor(c, "stuck", age=10.0)
+        c._check_stalls()
+        assert metrics.registry.get_gauge("stalled_tensors") == 1
+        # recovery: the next check with an empty table zeroes the gauge
+        c._message_table.clear()
+        c._last_stall_check = 0.0
+        c._check_stalls()
+        assert metrics.registry.get_gauge("stalled_tensors") == 0
+
+    def test_fresh_tensor_not_counted(self):
+        c = self._controller(warn=60.0)
+        self._stall_tensor(c, "young", age=0.001)
+        c._check_stalls()
+        assert metrics.registry.get_gauge("stalled_tensors") == 0
+
+    def test_stall_shutdown_increments_counter(self):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+
+        c = self._controller(warn=0.0, shut=0.01)
+        self._stall_tensor(c, "doomed", age=10.0)
+        with pytest.raises(HorovodInternalError, match="stall shutdown"):
+            c._check_stalls()
+        assert metrics.registry.get_counter("stall_shutdowns_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# trace merge
+# ---------------------------------------------------------------------------
+
+
+def _trace(rank, wall_base_ns, server_offset_ns, events):
+    head = [
+        {"name": "process_name", "ph": "M", "pid": rank,
+         "args": {"name": f"rank {rank}"}},
+        {"name": "clock_sync", "ph": "M", "pid": rank,
+         "args": {"wall_base_ns": wall_base_ns,
+                  "server_offset_ns": server_offset_ns, "rank": rank}},
+    ]
+    return head + events
+
+
+@pytest.mark.smoke
+class TestTraceMerge:
+    def test_clock_alignment_subtracts_skew(self):
+        from horovod_tpu.tools import trace_merge
+
+        # Rank 1's wall clock runs 5 ms ahead of rank 0's, and its
+        # server-offset estimate says exactly that: after alignment, two
+        # spans that happened at the same server time coincide.
+        t0 = _trace(0, 1_000_000_000, 0,
+                    [{"name": "A", "ph": "B", "pid": 0, "tid": 1, "ts": 100}])
+        t1 = _trace(1, 1_000_000_000 + 5_000_000, 5_000_000,
+                    [{"name": "A", "ph": "B", "pid": 1, "tid": 1, "ts": 100}])
+        merged = trace_merge.merge([json.loads(json.dumps(t)) for t in (t0, t1)])
+        ts = [e["ts"] for e in merged if e.get("ph") == "B"]
+        assert ts[0] == pytest.approx(ts[1])
+
+    def test_missing_clock_sync_falls_back_to_concat(self):
+        from horovod_tpu.tools import trace_merge
+
+        warnings = []
+        t0 = _trace(0, 1_000, 0,
+                    [{"name": "A", "ph": "B", "pid": 0, "tid": 1, "ts": 7}])
+        t1 = [{"name": "A", "ph": "B", "pid": 1, "tid": 1, "ts": 9}]
+        merged = trace_merge.merge([t0, t1], warn=warnings.append)
+        assert warnings and "WITHOUT" in warnings[0]
+        assert sorted(e["ts"] for e in merged if "ts" in e) == [7, 9]
+
+    def test_truncated_trace_is_repaired(self, tmp_path):
+        from horovod_tpu.tools import trace_merge
+
+        p = tmp_path / "trunc.json"
+        p.write_text('[\n{"name": "A", "ph": "B", "pid": 0, "ts": 1},\n'
+                     '{"name": "B", "ph": "E", "pid": 0, "ts":')  # cut mid-record
+        events = trace_merge.load_trace(str(p))
+        assert [e["name"] for e in events] == ["A"]
+
+    def test_cli_writes_merged_file(self, tmp_path):
+        from horovod_tpu.tools import trace_merge
+
+        for r in range(2):
+            (tmp_path / f"t{r}.json").write_text(json.dumps(
+                _trace(r, 1_000_000, 0,
+                       [{"name": "X", "ph": "B", "pid": r, "tid": 1,
+                         "ts": 5, "args": {"cycle": 3}}])))
+        out = tmp_path / "merged.json"
+        rc = trace_merge.main([str(tmp_path / "t0.json"),
+                               str(tmp_path / "t1.json"), "-o", str(out)])
+        assert rc == 0
+        merged = json.loads(out.read_text())
+        assert {e.get("pid") for e in merged if e.get("ph") == "B"} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# runtime timeline toggles (satellite: the core/timeline.py docstring's
+# promise, with balanced B/E per lane)
+# ---------------------------------------------------------------------------
+
+
+def test_start_stop_timeline_balanced_lanes(tmp_path):
+    import os
+
+    from horovod_tpu.core import state as state_mod
+
+    state_mod.reset_global_state()
+    os.environ.pop("HOROVOD_SIZE", None)
+    import horovod_tpu.frameworks.jax.basics as basics
+    import horovod_tpu.frameworks.jax.ops as ops
+
+    basics.init()
+    try:
+        tl = tmp_path / "toggle.json"
+        basics.start_timeline(str(tl), mark_cycles=True)
+        for i in range(3):
+            ops.allreduce(np.ones(8, np.float32), name=f"tg{i}")
+        basics.stop_timeline()
+        events = json.loads(tl.read_text())  # completed file parses
+        assert state_mod.global_state().timeline is None
+        # every lane's B (begin) events are balanced by E (end) events
+        per_lane = Counter()
+        for e in events:
+            if e.get("ph") in ("B", "E"):
+                per_lane[(e.get("pid"), e.get("tid"), e["ph"])] += 1
+        lanes = {(p, t) for (p, t, _ph) in per_lane}
+        assert lanes, "no span events recorded"
+        for p, t in lanes:
+            assert per_lane[(p, t, "B")] == per_lane[(p, t, "E")], \
+                (p, t, per_lane)
+        # spans are cycle-tagged and the clock_sync anchor is present
+        assert any(e.get("args", {}).get("cycle") for e in events
+                   if e.get("ph") == "B")
+        assert any(e.get("name") == "clock_sync" for e in events)
+        # a second start after stop works (toggle, not one-shot)
+        tl2 = tmp_path / "toggle2.json"
+        basics.start_timeline(str(tl2))
+        ops.allreduce(np.ones(8, np.float32), name="tg_again")
+        basics.stop_timeline()
+        assert any(e.get("ph") == "B" for e in json.loads(tl2.read_text()))
+    finally:
+        state_mod.global_state().shutdown()
+        state_mod.reset_global_state()
+
+
+# ---------------------------------------------------------------------------
+# np=2 end-to-end proofs (chaos-marked: multiprocess jobs sort last)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(240)
+def test_metrics_scrape_e2e_np2():
+    """Acceptance proof (a): a live np=2 job's ``GET /metrics`` serves
+    Prometheus text with cross-rank collective latency histograms and
+    per-rank gauges."""
+    body = """
+import time, urllib.request
+for i in range(6):
+    hvd.allreduce(np.ones(1024, np.float32), name=f"m{i % 2}")
+hvd.barrier()
+time.sleep(1.2)
+hvd.barrier()
+if rank == 0:
+    addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+    port = os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]
+    deadline = time.time() + 30
+    text = ""
+    while time.time() < deadline:
+        text = urllib.request.urlopen(
+            f"http://{addr}:{port}/metrics", timeout=5).read().decode()
+        if ('hvd_collective_latency_seconds_bucket' in text
+                and 'rank="1"' in text):
+            break
+        time.sleep(0.3)
+    assert 'hvd_collective_latency_seconds_bucket' in text, text[:3000]
+    assert 'op="ALLREDUCE"' in text, text[:3000]
+    assert 'dtype="FLOAT32"' in text, text[:3000]
+    assert 'rank="0"' in text and 'rank="1"' in text, text[:3000]
+    assert 'hvd_wire_bytes_on_wire_total' in text, text[:3000]
+    assert '# TYPE hvd_collective_latency_seconds histogram' in text
+    print("SCRAPE_OK", flush=True)
+"""
+    outs = run_distributed(
+        2, body, timeout=180,
+        extra_env={"HOROVOD_METRICS_PUSH_SECS": "0.2"})
+    assert "SCRAPE_OK" in outs[0], outs[0]
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(240)
+def test_trace_merge_e2e_np2(tmp_path):
+    """Acceptance proof (b): per-rank traces from a real np=2 job merge
+    into one Chrome trace where both ranks' lanes for the same collective
+    share a negotiation cycle id."""
+    from horovod_tpu.tools import trace_merge
+
+    tl = tmp_path / "tl.json"
+    run_distributed(2, """
+for i in range(4):
+    hvd.allreduce(np.ones(64, np.float32), name="tm0")
+""", timeout=180, extra_env={"HOROVOD_TIMELINE": str(tl)})
+    merged_path = tmp_path / "merged.json"
+    rc = trace_merge.main([str(tl), f"{tl}.rank1", "-o", str(merged_path)])
+    assert rc == 0
+    events = json.loads(merged_path.read_text())
+    lane_names = {
+        (e["pid"], e["tid"]): e["args"]["name"] for e in events
+        if e.get("name") == "thread_name" and e.get("ph") == "M"}
+    cycles = {0: [], 1: []}
+    for e in events:
+        if e.get("ph") == "B" and e.get("name") == "ALLREDUCE" \
+                and lane_names.get((e["pid"], e["tid"])) == "tm0":
+            cycles[e["pid"]].append(e["args"]["cycle"])
+    assert cycles[0], "rank 0 recorded no ALLREDUCE spans"
+    assert cycles[1], "rank 1 recorded no ALLREDUCE spans"
+    assert sorted(cycles[0]) == sorted(cycles[1]), \
+        "ranks disagree on the cycle ids of the same collectives"
