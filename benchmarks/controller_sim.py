@@ -20,6 +20,16 @@ Outputs one JSON line per (world_size, scenario):
 
 Run: ``python benchmarks/controller_sim.py [--world-sizes 8 16 64 256]
 [--tensors 50] [--out benchmarks/results/controller_sim.json]``
+
+``--churn`` switches to the CONTROL-plane cost model (ROADMAP item 4
+seed): a real journaled rendezvous server, driven over the real
+HTTPStoreClient with the op mix one membership-churn event costs the
+elastic driver at world size N — full lease scan (keys + N gets), slot
+table republish (N puts), and a full round of lease renewals (N puts) —
+plus what durability adds: journal bytes on disk, compaction
+generations, and cold-restart replay time.  Baseline artifact:
+``python benchmarks/controller_sim.py --churn --world-sizes 64
+--out benchmarks/results/controller_churn_np64.json``.
 """
 
 from __future__ import annotations
@@ -149,18 +159,122 @@ def run_case(world: int, tensors: int, cycles: int) -> dict:
     }
 
 
+def _percentile(sorted_ms, frac):
+    return round(sorted_ms[min(int(len(sorted_ms) * frac),
+                               len(sorted_ms) - 1)], 3)
+
+
+def run_churn_case(world: int, events: int) -> dict:
+    """One membership-churn baseline at world size N, end to end through
+    the journaled rendezvous server (started in-process, driven over
+    HTTP like a real driver would)."""
+    import shutil
+    import tempfile
+
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    from horovod_tpu.transport.store import LEASE_SCOPE, HTTPStoreClient
+
+    jdir = tempfile.mkdtemp(prefix="hvd-churn-")
+    server = RendezvousServer("127.0.0.1", journal_dir=jdir)
+    port = server.start()
+    client = HTTPStoreClient("127.0.0.1", port)
+    identities = [f"host{r:03d}:0" for r in range(world)]
+
+    def publish_table(epoch: int) -> None:
+        for rank, identity in enumerate(identities):
+            client.set("rank_and_size", identity, json.dumps({
+                "hostname": identity.split(":")[0], "rank": rank,
+                "local_rank": 0, "cross_rank": rank, "size": world,
+                "local_size": 1, "cross_size": world, "epoch": epoch,
+            }).encode())
+        client.set("driver", "epoch", str(epoch).encode())
+
+    def renew_leases(epoch: int, renewal: int) -> None:
+        for rank, identity in enumerate(identities):
+            client.set(LEASE_SCOPE, identity, json.dumps({
+                "rank": rank, "epoch": epoch,
+                "renewals": renewal}).encode())
+
+    def lease_scan() -> None:
+        for identity in client.keys(LEASE_SCOPE):
+            client.get(LEASE_SCOPE, identity)
+
+    t0 = time.perf_counter()
+    publish_table(0)
+    renew_leases(0, 0)
+    bringup_ms = (time.perf_counter() - t0) * 1e3
+
+    event_ms, scan_ms, republish_ms = [], [], []
+    for event in range(events):
+        # One churn event = what one epoch advance costs the driver:
+        # scan every lease, republish the whole table, absorb a renewal
+        # round at the new epoch.  Deterministic — no randomness.
+        t0 = time.perf_counter()
+        lease_scan()
+        t1 = time.perf_counter()
+        publish_table(event + 1)
+        t2 = time.perf_counter()
+        renew_leases(event + 1, event + 1)
+        t3 = time.perf_counter()
+        scan_ms.append((t1 - t0) * 1e3)
+        republish_ms.append((t2 - t1) * 1e3)
+        event_ms.append((t3 - t0) * 1e3)
+    server.stop()
+
+    journal_bytes = sum(
+        os.path.getsize(os.path.join(jdir, f)) for f in os.listdir(jdir))
+    generations = sorted(f for f in os.listdir(jdir)
+                         if f.startswith("journal-"))
+
+    # Cold-restart cost: the survivability price a supervisor pays.
+    from horovod_tpu.transport.store import DurableMemoryStore
+
+    t0 = time.perf_counter()
+    replayed = DurableMemoryStore(jdir)
+    replay_ms = (time.perf_counter() - t0) * 1e3
+    replayed_keys = len(replayed.keys(LEASE_SCOPE)) + \
+        len(replayed.keys("rank_and_size"))
+    replayed.close()
+    shutil.rmtree(jdir, ignore_errors=True)
+
+    event_ms.sort(), scan_ms.sort(), republish_ms.sort()
+    return {
+        "metric": "controller_churn",
+        "world_size": world,
+        "events": events,
+        "bringup_ms": round(bringup_ms, 3),
+        "event_ms_p50": _percentile(event_ms, 0.5),
+        "event_ms_p99": _percentile(event_ms, 0.99),
+        "lease_scan_ms_p50": _percentile(scan_ms, 0.5),
+        "republish_ms_p50": _percentile(republish_ms, 0.5),
+        "journal_bytes": journal_bytes,
+        "journal_generation": int(generations[-1].split("-")[1])
+        if generations else 0,
+        "replay_ms": round(replay_ms, 3),
+        "replayed_keys": replayed_keys,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--world-sizes", type=int, nargs="+",
                    default=[8, 16, 64, 256])
     p.add_argument("--tensors", type=int, default=50)
     p.add_argument("--cycles", type=int, default=200)
+    p.add_argument("--churn", action="store_true",
+                   help="membership-churn cost against a real journaled "
+                        "rendezvous server instead of the coordinator sim")
+    p.add_argument("--events", type=int, default=20,
+                   help="churn events per world size (--churn only)")
     p.add_argument("--out", default=None)
     args = p.parse_args()
 
     lines = []
     for world in args.world_sizes:
-        rec = run_case(world, args.tensors, args.cycles)
+        if args.churn:
+            rec = run_churn_case(world, args.events)
+        else:
+            rec = run_case(world, args.tensors, args.cycles)
         line = json.dumps(rec)
         print(line, flush=True)
         lines.append(line)

@@ -5,7 +5,11 @@
 # errors on all survivors, or a successful elastic recovery) under
 # per-test wall-clock bounds.  The integrity-plane cases (wire-CRC
 # corruption, truncated frames, kill-mid-ckpt.save, and the elastic
-# corruption-recovery bit-identical proof) ride the same lane; suite
+# corruption-recovery bit-identical proof) ride the same lane, as do the
+# control-plane survivability cases (lease-expiry epoch advance, and the
+# SIGKILL-and-restart of the external journaled rendezvous server that
+# must converge bit-identical with zero epoch bumps —
+# docs/control_plane.md); suite
 # order keeps them AFTER the fast in-process spec tests and np=2/np=4
 # abort cases, per the tier-1 budget rule — heavy multiprocess tests run
 # late so DOTS_PASSED comparison stays meaningful on the 1-core box.

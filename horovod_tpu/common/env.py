@@ -35,6 +35,28 @@ HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
 # Negotiation fan-out: "auto" | "star" | "tree" (core/controller.py picks
 # tree at the measured world-size crossover when auto).
 HOROVOD_CONTROLLER_TOPOLOGY = "HOROVOD_CONTROLLER_TOPOLOGY"
+# -- control-plane survivability (docs/control_plane.md) --
+# Directory for the rendezvous store's write-ahead journal + compacted
+# snapshots; empty/unset = no journal (plain in-memory store).  A server
+# restarted over the same directory replays to its pre-crash KV state.
+HOROVOD_RENDEZVOUS_JOURNAL_DIR = "HOROVOD_RENDEZVOUS_JOURNAL_DIR"
+# fsync each journal append ("1"/"0", default on): off trades the last
+# few acknowledged ops on power loss for lower PUT latency; a plain
+# process SIGKILL loses nothing either way (the page cache survives).
+HOROVOD_RENDEZVOUS_JOURNAL_FSYNC = "HOROVOD_RENDEZVOUS_JOURNAL_FSYNC"
+# Ops between snapshot compactions (bounds journal replay length).
+HOROVOD_RENDEZVOUS_SNAPSHOT_EVERY = "HOROVOD_RENDEZVOUS_SNAPSHOT_EVERY"
+# "host:port" of an externally-supervised rendezvous server (run
+# ``python -m horovod_tpu.runner.rendezvous``); when set, the elastic
+# launcher drives that server over HTTP instead of starting its own —
+# the deployment shape where a SIGKILL'd server restarts under its
+# supervisor and the job rides through.  Both sides must share
+# HOROVOD_SECRET_KEY.
+HOROVOD_RENDEZVOUS_EXTERNAL = "HOROVOD_RENDEZVOUS_EXTERNAL"
+# Seconds without a lease renewal (with the store REACHABLE) before the
+# elastic driver declares a worker dead and advances the epoch; store
+# outages pause the clock — partitioned/restarting is not dead.
+HOROVOD_LEASE_TIMEOUT_SECS = "HOROVOD_LEASE_TIMEOUT_SECS"
 
 # -- elastic membership --
 # Monotonic membership epoch, stamped by the elastic driver into every
@@ -180,6 +202,14 @@ DEFAULT_METRICS_PUSH_SECS = 5.0
 # (faults, epoch changes, aborts) — sized so idle control-frame chatter
 # cannot evict a whole incident's history.
 DEFAULT_FLIGHT_RECORDER_EVENTS = 512
+# 512 ops between compactions: elastic churn writes ~2N keys per epoch,
+# so replay stays bounded at a few epochs' worth of ops even at np=64
+# while steady-state lease renewals don't compact every few seconds.
+DEFAULT_RENDEZVOUS_SNAPSHOT_EVERY = 512
+# 3× the default metrics-push period: one missed renewal is load noise,
+# three in a row with a reachable store means the pusher thread (and so
+# almost certainly the worker) is gone.
+DEFAULT_LEASE_TIMEOUT_SECS = 15.0
 
 
 def get_int(name: str, default: int) -> int:

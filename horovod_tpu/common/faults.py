@@ -11,7 +11,8 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, clauses joined by ``;``)::
 
     clause  := site[:key=value]...
     site    := tcp.send | tcp.recv | controller.negotiate |
-               dispatch.collective | rendezvous.get | worker.spawn
+               dispatch.collective | rendezvous.get | worker.spawn |
+               ckpt.save | store.put | store.get_serve | driver.tick
     keys    := rank=N       only fire on this Horovod rank
                peer=N       only fire when the op targets this peer rank
                nth=N        fire exactly on the N-th matching call (1-based)
@@ -75,6 +76,9 @@ SITES = (
     "rendezvous.get",
     "worker.spawn",
     "ckpt.save",
+    "store.put",
+    "store.get_serve",
+    "driver.tick",
 )
 
 _ACTIONS = ("hang", "delay_ms", "raise", "raise_oserror", "exit", "drop",

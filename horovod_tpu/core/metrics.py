@@ -92,10 +92,19 @@ CATALOG: Dict[str, Tuple[str, str]] = {
         "counter", "hard stall-shutdown aborts fired (coordinator only)"),
     # -- rendezvous / elastic --
     "rendezvous_store_ops_total": (
-        "counter", "HTTP KV store requests, labeled op=get|set|delete"),
+        "counter", "HTTP KV store requests, labeled op=get|set|delete|keys"),
     "elastic_epoch": ("gauge", "membership epoch this process last adopted"),
     "elastic_epoch_changes_total": (
         "counter", "elastic re-rendezvous epoch adoptions"),
+    "store_outage_seconds_total": (
+        "counter", "seconds the rendezvous store was unreachable from "
+                   "this process's push loop (accumulated across outages)"),
+    "lease_renew_failures_total": (
+        "counter", "liveness-lease renewals that failed to reach the "
+                   "rendezvous store"),
+    "lease_expirations_total": (
+        "counter", "worker leases the elastic driver declared expired "
+                   "(dead worker => epoch advance; driver only)"),
     # -- integrity / failure plane --
     "crc_verify_seconds_total": (
         "counter", "seconds spent computing/verifying wire CRC32 "

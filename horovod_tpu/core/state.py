@@ -280,30 +280,71 @@ class HorovodGlobalState:
     def _start_metrics_pusher(self, store) -> None:
         """Periodically push this rank's metrics snapshot to the
         rendezvous KV (``PUT /metrics/rank-N``) so the server's
-        ``GET /metrics`` can serve a cross-rank aggregate of a LIVE job.
-        One small PUT per period; 0 disables."""
+        ``GET /metrics`` can serve a cross-rank aggregate of a LIVE job,
+        and renew this identity's liveness lease on the same cadence
+        (``PUT /lease/<identity>`` — the elastic driver's dead-vs-
+        partitioned signal, docs/control_plane.md).  One small PUT pair
+        per period; 0 disables."""
         period = env_mod.get_float(env_mod.HOROVOD_METRICS_PUSH_SECS,
                                    env_mod.DEFAULT_METRICS_PUSH_SECS)
         if period <= 0 or not metrics.ENABLED:
             return
         import json as json_mod
 
+        from ..transport.store import LEASE_SCOPE
+
         rank = self.topo.rank
         done = self.shutdown_complete
+        identity = (
+            f"{env_mod.get_str(env_mod.HOROVOD_HOSTNAME) or 'localhost'}:"
+            f"{env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)}")
+        # Store-outage state machine: pushes are best-effort.  Each
+        # attempt rebuilds the snapshot (so the NEWEST one is what lands
+        # when the store returns — nothing stale is ever replayed), we
+        # log once per outage instead of once per period, and the blind
+        # window is accumulated into counters the first post-outage
+        # snapshot carries out.  Boxed floats: closure-mutable state.
+        outage_since = [None]   # monotonic start of the current outage
+        counted_upto = [0.0]    # outage seconds already accounted
+        renewals = [0]          # lease value must CHANGE every renewal
 
         def _push() -> None:
+            renewals[0] += 1
+            snap = metrics.registry.snapshot()
+            snap["rank"] = rank
+            # Epoch-stamped so the scrape can drop snapshots from
+            # ranks that left at an elastic re-rendezvous (their last
+            # push would otherwise be served forever).
+            snap["epoch"] = env_mod.get_epoch()
+            lease = json_mod.dumps({
+                "rank": rank, "epoch": env_mod.get_epoch(),
+                "renewals": renewals[0]}).encode()
             try:
-                snap = metrics.registry.snapshot()
-                snap["rank"] = rank
-                # Epoch-stamped so the scrape can drop snapshots from
-                # ranks that left at an elastic re-rendezvous (their last
-                # push would otherwise be served forever).
-                snap["epoch"] = env_mod.get_epoch()
                 store.set(metrics.METRICS_SCOPE, f"rank-{rank}",
                           json_mod.dumps(snap).encode())
-            except Exception as e:  # noqa: BLE001 — a scrape gap must
-                # never hurt the job; the store may be restarting.
-                log.debug("metrics push failed: %s", e)
+                store.set(LEASE_SCOPE, identity, lease)
+            except Exception as e:  # noqa: BLE001 — a scrape/lease gap
+                # must never hurt the job; the store may be restarting.
+                now = time.monotonic()
+                metrics.inc("lease_renew_failures_total")
+                if outage_since[0] is None:
+                    outage_since[0] = now
+                    log.warning(
+                        "rendezvous store unreachable (%s); metrics/lease "
+                        "pushes degrade to best-effort until it returns", e)
+                else:
+                    metrics.inc("store_outage_seconds_total",
+                                now - counted_upto[0])
+                counted_upto[0] = now
+                return
+            if outage_since[0] is not None:
+                now = time.monotonic()
+                metrics.inc("store_outage_seconds_total",
+                            now - counted_upto[0])
+                log.info("rendezvous store reachable again after %.1fs; "
+                         "resuming normal pushes",
+                         now - outage_since[0])
+                outage_since[0] = None
 
         def _push_loop() -> None:
             _push()

@@ -32,12 +32,15 @@ import json
 import threading
 import time
 from collections import defaultdict
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..common import env as env_mod
+from ..common import faults
 from ..common.logging_util import get_logger
+from ..core import metrics
 from ..runner.hosts import SlotInfo, get_host_assignments
 from ..runner.rendezvous import RendezvousServer
+from ..transport.store import LEASE_SCOPE
 from .constants import (
     DEFAULT_CRASH_FAILURE_LIMIT,
     DEFAULT_TRANSIENT_FAILURE_LIMIT,
@@ -52,14 +55,25 @@ from .worker import WORKERS_SCOPE, WorkerNotificationClient
 
 log = get_logger("horovod_tpu.elastic.driver")
 
+#: Scope the driver persists its own durable state in (currently just the
+#: epoch) so a restarted driver can re-adopt instead of resetting to 0.
+DRIVER_SCOPE = "driver"
+
 
 class ElasticDriver:
+    #: Store-outage shapes: a dead/restarting rendezvous server surfaces
+    #: from the HTTP client as URLError/ConnectionError — both OSError.
+    #: The in-process server never raises, so partitioned mode only ever
+    #: engages against an external (HOROVOD_RENDEZVOUS_EXTERNAL) store.
+    _STORE_ERRORS = OSError
+
     def __init__(self, rendezvous: RendezvousServer, host_manager: HostManager,
                  min_np: int, max_np: Optional[int] = None,
                  reset_limit: Optional[int] = None,
                  timeout: float = ELASTIC_TIMEOUT_SECS,
                  crash_failure_limit: Optional[int] = None,
-                 transient_failure_limit: Optional[int] = None):
+                 transient_failure_limit: Optional[int] = None,
+                 lease_timeout: Optional[float] = None):
         self.rendezvous = rendezvous
         self.hosts = host_manager
         self.min_np = min_np
@@ -95,6 +109,21 @@ class ElasticDriver:
         # never be handed a rank in a fresh epoch (reference
         # registration.py:139-143 stops the driver on first SUCCESS).
         self._success = False
+        # -- lease-based liveness (docs/control_plane.md) --------------
+        self.lease_timeout = lease_timeout if lease_timeout is not None \
+            else env_mod.get_float(env_mod.HOROVOD_LEASE_TIMEOUT_SECS,
+                                   env_mod.DEFAULT_LEASE_TIMEOUT_SECS)
+        # identity -> (last lease value seen, monotonic time it CHANGED).
+        # Freshness is time-since-last-value-change on OUR clock — worker
+        # clocks never enter the judgment (renewals bump a counter, so a
+        # live worker's value always changes).
+        self._lease_seen: Dict[str, Tuple[bytes, float]] = {}
+        # Monotonic deadline before which no lease may expire: armed
+        # after a store outage ends (workers couldn't renew through it)
+        # and after driver recovery (replayed values are pre-crash), so
+        # every worker gets one full timeout to show life first.
+        self._lease_grace_until = 0.0
+        self._store_outage_since: Optional[float] = None
 
     # ------------------------------------------------------------------
 
@@ -170,6 +199,11 @@ class ElasticDriver:
             for identity, slot in table.items():
                 self.rendezvous.set("rank_and_size", identity,
                                     json.dumps(slot).encode())
+            # Durable driver state: a restarted driver re-adopts this
+            # epoch (recover_from_store) instead of resetting to 0 and
+            # respawning the world.
+            self.rendezvous.set(DRIVER_SCOPE, "epoch",
+                                str(self.epoch).encode())
 
             # Spawn processes for identities that have none yet.  A
             # driver-spawned worker is born at this epoch, so it is
@@ -221,7 +255,24 @@ class ElasticDriver:
             self._wakeup.clear()
             if self._shutdown.is_set():
                 return
-            self._renotify_unacked()
+            # Chaos site for driver-death scenarios: action=raise kills
+            # this thread (a wedged driver), exit kills the launcher.
+            # Deliberately OUTSIDE the outage try — an injected raise
+            # must not read as "store unreachable".
+            if faults.ACTIVE:
+                faults.inject("driver.tick")
+            # Every per-tick store op rides one try: a failure means the
+            # store is down/partitioned, NOT that workers died — freeze
+            # membership judgment (no lease expiry, no epoch advance)
+            # until it answers again, then re-grace the lease clocks.
+            try:
+                self._renotify_unacked()
+                reset_reasons = self._pending_reset_requests()
+                expired = self._scan_leases()
+                self._store_recovered()
+            except self._STORE_ERRORS as e:
+                self._store_outage(e)
+                continue
             try:
                 changed, removal = self.hosts.update_available_hosts()
             except Exception as e:  # noqa: BLE001 — discovery script hiccups
@@ -236,10 +287,22 @@ class ElasticDriver:
                     # rank to the dead-but-successful identity and hang the
                     # survivors' mesh build.
                     continue
+                if expired:
+                    # A lease expired with the store REACHABLE: the worker
+                    # is genuinely dead (or wedged past saving) — drop it
+                    # from the known set so the missing-workers path below
+                    # advances the epoch THIS tick.
+                    metrics.inc("lease_expirations_total", len(expired))
+                    for identity in sorted(expired):
+                        log.warning(
+                            "worker %s lease expired (no renewal in %.0fs "
+                            "with the store reachable); declaring dead",
+                            identity, self.lease_timeout)
+                        self._known_identities.pop(identity, None)
+                        self._lease_seen.pop(identity, None)
                 missing_workers = {
                     f"{s.hostname}:{s.local_rank}" for s in self._slots
                 } - set(self._known_identities)
-            reset_reasons = self._pending_reset_requests()
             if not changed and not missing_workers and not reset_reasons:
                 continue
             if self.reset_limit is not None and \
@@ -290,6 +353,107 @@ class ElasticDriver:
                 reasons.append(
                     f"{identity}: {req.get('reason', 'unspecified')}")
         return reasons
+
+    # -- lease liveness / store outage (docs/control_plane.md) ---------
+
+    def _scan_leases(self) -> Set[str]:
+        """Identities whose lease EXPIRED while the store was reachable.
+
+        Identities that never posted a lease are exempt (metrics pushes
+        disabled, or a pre-survivability worker) — exit-watching still
+        covers those.  Raises the store error on outage: the caller's
+        partitioned mode is the only place that decides what that means."""
+        now = time.monotonic()
+        with self._lock:
+            identities = {f"{s.hostname}:{s.local_rank}"
+                          for s in self._slots}
+        leased = set(self.rendezvous.keys(LEASE_SCOPE))
+        expired: Set[str] = set()
+        for identity in sorted(identities & leased):
+            raw = self.rendezvous.get(LEASE_SCOPE, identity)
+            if raw is None:
+                continue
+            seen = self._lease_seen.get(identity)
+            if seen is None or seen[0] != raw:
+                self._lease_seen[identity] = (raw, now)
+                continue
+            if now >= self._lease_grace_until and \
+                    now - seen[1] > self.lease_timeout:
+                expired.add(identity)
+        # Drop tracking for identities that left the slot table.
+        for identity in list(self._lease_seen):
+            if identity not in identities:
+                del self._lease_seen[identity]
+        return expired
+
+    def _store_outage(self, err: Exception) -> None:
+        if self._store_outage_since is None:
+            self._store_outage_since = time.monotonic()
+            log.warning("rendezvous store unreachable (%s); entering "
+                        "partitioned mode — no membership changes until "
+                        "it returns", err)
+
+    def _store_recovered(self) -> None:
+        if self._store_outage_since is None:
+            return
+        outage = time.monotonic() - self._store_outage_since
+        self._store_outage_since = None
+        # Workers could not renew through the outage (their pushes go to
+        # the same store); restart the judgment clock so a restarted
+        # server's replayed leases don't read as instantly expired.
+        self._lease_grace_until = time.monotonic() + self.lease_timeout
+        log.info("rendezvous store reachable again after %.1fs outage; "
+                 "lease clocks re-graced for %.0fs", outage,
+                 self.lease_timeout)
+
+    def recover_from_store(self) -> bool:
+        """Driver crash-recovery: re-adopt a previous incarnation's state
+        from a (journaled) store before :meth:`start`.
+
+        Restores the epoch and seeds ``_known_identities`` from the
+        leases of workers whose slot entry holds a rank at that epoch, so
+        ``start()`` republishes the SAME epoch and spawns only identities
+        with no surviving worker — instead of resetting to epoch 0 and
+        respawning the world.  Returns True when prior state was found."""
+        try:
+            raw = self.rendezvous.get(DRIVER_SCOPE, "epoch")
+            if raw is None:
+                return False
+            self.epoch = int(raw.decode())
+            now = time.monotonic()
+            adopted = []
+            for identity in self.rendezvous.keys(LEASE_SCOPE):
+                lease = self.rendezvous.get(LEASE_SCOPE, identity)
+                slot_raw = self.rendezvous.get(
+                    rendezvous_client.RANK_AND_SIZE_SCOPE, identity)
+                if lease is None or slot_raw is None:
+                    continue
+                try:
+                    slot = json.loads(slot_raw.decode())
+                except ValueError:
+                    continue
+                if slot.get("rank", -1) < 0 or \
+                        slot.get("epoch", -1) != self.epoch:
+                    continue
+                info = SlotInfo(
+                    hostname=slot["hostname"], rank=slot["rank"],
+                    local_rank=slot["local_rank"],
+                    cross_rank=slot["cross_rank"], size=slot["size"],
+                    local_size=slot["local_size"],
+                    cross_size=slot["cross_size"])
+                with self._lock:
+                    self._known_identities[identity] = info
+                    self._lease_seen[identity] = (lease, now)
+                adopted.append(identity)
+        except (self._STORE_ERRORS, ValueError) as e:
+            log.warning("driver state recovery failed (%s); starting "
+                        "fresh at epoch 0", e)
+            return False
+        self._lease_grace_until = time.monotonic() + self.lease_timeout
+        log.info("recovered driver state from store: epoch %d, re-adopted "
+                 "live workers %s", self.epoch,
+                 sorted(adopted) or "(none)")
+        return True
 
     # ------------------------------------------------------------------
 

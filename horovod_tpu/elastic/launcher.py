@@ -63,9 +63,29 @@ def launch_elastic_job(args, command: List[str]) -> int:
     from ..common import secret as secret_mod
 
     job_secret = secret_mod.ensure_job_secret()
-    server = RendezvousServer(bind_addr="0.0.0.0",
-                              job_secret=job_secret.encode())
-    port = server.start()
+    # Survivable deployment (docs/control_plane.md): with
+    # HOROVOD_RENDEZVOUS_EXTERNAL=host:port the launcher attaches to a
+    # supervisor-managed, journaled rendezvous server instead of owning
+    # one — a SIGKILL'd server restarts and replays, and the driver's
+    # partitioned mode rides out the outage without epoch churn.  Both
+    # sides must share HOROVOD_SECRET_KEY (ensure_job_secret generated
+    # one just now if the operator didn't set it — set it explicitly for
+    # external mode or the signatures won't match).
+    external = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_EXTERNAL)
+    if external:
+        from ..runner.rendezvous import ExternalRendezvous
+
+        host, _, p = external.rpartition(":")
+        if not host or not p.isdigit():
+            raise SystemExit(
+                "hvdrun: HOROVOD_RENDEZVOUS_EXTERNAL must be host:port, "
+                f"got {external!r}")
+        server = ExternalRendezvous(host, int(p))
+        port = server.port
+    else:
+        server = RendezvousServer(bind_addr="0.0.0.0",
+                                  job_secret=job_secret.encode())
+        port = server.start()
     min_np = args.min_np or args.num_proc
     # --start-timeout in elastic mode bounds slot assembly (reference:
     # elastic settings use start_timeout for wait_for_available_slots).
@@ -75,6 +95,12 @@ def launch_elastic_job(args, command: List[str]) -> int:
     driver = ElasticDriver(
         server, HostManager(discovery), min_np=min_np, max_np=args.max_np,
         reset_limit=args.reset_limit, **driver_kwargs)
+    if external:
+        # A restarted launcher re-adopts a previous incarnation's epoch
+        # and live workers from the journaled store (no-op on a fresh
+        # journal).  Use a per-job journal dir: stale state from an OLD
+        # job would be re-adopted too.
+        driver.recover_from_store()
 
     from ..transport.tcp import _default_advertise_addr
 
@@ -95,8 +121,11 @@ def launch_elastic_job(args, command: List[str]) -> int:
         # could never re-form.  Elastic TPU jobs therefore run one process
         # per host (the host's default libtpu ownership of all its chips),
         # which also matches how preemption works: whole hosts come & go.
-        env = _slot_env(slot, rdv_addr if not _is_local(slot.hostname)
-                        else "127.0.0.1", port, extra,
+        # External mode: every worker dials the external server's address
+        # (it need not be on this host); otherwise the launcher's own.
+        slot_rdv_addr = server.addr if external else (
+            rdv_addr if not _is_local(slot.hostname) else "127.0.0.1")
+        env = _slot_env(slot, slot_rdv_addr, port, extra,
                         tpu_chip_binding=False)
         env[env_mod.HOROVOD_EPOCH] = str(epoch)
         proc = spawn_worker(slot, command, env)

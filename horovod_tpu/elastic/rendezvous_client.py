@@ -31,6 +31,20 @@ def _identity() -> str:
     return f"{hostname}:{local_rank}"
 
 
+def store_client() -> Optional[HTTPStoreClient]:
+    """The worker's rendezvous store client, resolved from the ambient
+    env (None outside launched jobs).  Resolved FRESH on every call by
+    design: clients are stateless over HTTP, and re-resolving is what
+    lets a worker re-attach (and re-authenticate — the HMAC secret is
+    re-read from env) to a rendezvous server that restarted on the same
+    address mid-outage (docs/control_plane.md)."""
+    addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        return None
+    return HTTPStoreClient(addr, port)
+
+
 def request_reset(reason: str) -> bool:
     """Ask the elastic driver to advance the membership epoch.
 
@@ -44,9 +58,8 @@ def request_reset(reason: str) -> bool:
     Best-effort and epoch-stamped: the driver only honors a request
     carrying its CURRENT epoch (anything older was answered by a later
     bump already).  Returns whether the request was posted."""
-    addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
-    port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
-    if not addr or not port:
+    store = store_client()
+    if store is None:
         return False
     payload = json.dumps({"epoch": env_mod.get_epoch(),
                           "reason": reason[:512]}).encode()
@@ -55,8 +68,7 @@ def request_reset(reason: str) -> bool:
 
         flight_recorder.record("reset_request", epoch=env_mod.get_epoch(),
                                reason=reason[:300])
-        HTTPStoreClient(addr, port).set(
-            RESET_REQUEST_SCOPE, _identity(), payload)
+        store.set(RESET_REQUEST_SCOPE, _identity(), payload)
         return True
     except Exception:  # noqa: BLE001 — the retry loop falls back to the
         # slow path (reinit timeout → transient exit → respawn) if the
@@ -68,11 +80,9 @@ def request_reset(reason: str) -> bool:
 def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
     """Blocks until the driver publishes a slot table for a NEW epoch, then
     adopts this process's new coordinates (exits if removed)."""
-    addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
-    port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
-    if not addr or not port:
+    store = store_client()
+    if store is None:
         raise RuntimeError("elastic re-init requires a rendezvous server")
-    store = HTTPStoreClient(addr, port)
     my_epoch = env_mod.get_epoch()
 
     # Exponential backoff with jitter (capped ~2 s): after a host failure

@@ -16,7 +16,7 @@ from typing import List, Optional
 
 from ..common import env as env_mod
 from ..common.logging_util import get_logger
-from ..transport.store import HTTPStoreClient, Store
+from ..transport.store import Store
 
 log = get_logger("horovod_tpu.elastic.worker")
 
@@ -62,17 +62,27 @@ def start_notification_service(store: Optional[Store] = None) -> int:
     port = server.server_address[1]
 
     if store is None:
-        addr = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
-        srv_port = env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
-        if not addr or not srv_port:
+        from .rendezvous_client import store_client
+
+        store = store_client()
+        if store is None:
             return 0
-        store = HTTPStoreClient(addr, srv_port)
     identity = (f"{env_mod.get_str(env_mod.HOROVOD_HOSTNAME) or 'localhost'}:"
                 f"{env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)}")
     from ..transport.tcp import _default_advertise_addr
 
-    store.set(WORKERS_SCOPE, identity,
-              f"{_default_advertise_addr()}:{port}".encode())
+    try:
+        store.set(WORKERS_SCOPE, identity,
+                  f"{_default_advertise_addr()}:{port}".encode())
+    except OSError as e:
+        # Store mid-restart: registration is best-effort — a journaled
+        # server replays a PREVIOUS registration of this identity (same
+        # address, new ephemeral port is the loss), and the driver's
+        # re-notify loop logs the identity as unregistered rather than
+        # failing the worker's init over an observability channel.
+        log.warning("worker notify-address registration failed (store "
+                    "unreachable: %s); driver pings may miss this "
+                    "worker until re-registration", e)
     return port
 
 

@@ -273,9 +273,28 @@ def launch_job(args, command: List[str]) -> int:
     from ..common import secret as secret_mod
 
     job_secret = secret_mod.ensure_job_secret()
-    server = RendezvousServer(bind_addr="0.0.0.0",
-                              job_secret=job_secret.encode())
-    port = server.start()
+    # Survivable shape (docs/control_plane.md), same contract as the
+    # elastic launcher: with HOROVOD_RENDEZVOUS_EXTERNAL=host:port the
+    # static launcher attaches to a supervisor-managed journaled server
+    # instead of owning one, so a plain -np job also rides out a
+    # rendezvous restart (worker store clients reattach per call).
+    # Both sides must share HOROVOD_SECRET_KEY.
+    ext_host = None
+    external = env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_EXTERNAL)
+    if external:
+        from .rendezvous import ExternalRendezvous
+
+        ext_host, _, ext_port = external.rpartition(":")
+        if not ext_host or not ext_port.isdigit():
+            raise SystemExit(
+                "hvdrun: HOROVOD_RENDEZVOUS_EXTERNAL must be host:port, "
+                f"got {external!r}")
+        server = ExternalRendezvous(ext_host, int(ext_port))
+        port = server.port
+    else:
+        server = RendezvousServer(bind_addr="0.0.0.0",
+                                  job_secret=job_secret.encode())
+        port = server.start()
     server.publish_slots([{
         "hostname": s.hostname, "rank": s.rank, "local_rank": s.local_rank,
         "cross_rank": s.cross_rank, "size": s.size,
@@ -286,6 +305,10 @@ def launch_job(args, command: List[str]) -> int:
 
     any_remote = any(not _is_local(s.hostname) for s in slots)
     rdv_addr = _default_advertise_addr() if any_remote else "127.0.0.1"
+    # Workers talk to the external server's host when attached; rdv_addr
+    # stays the local advertise address (the jax coordinator below runs
+    # in rank 0's process regardless of where the KV store lives).
+    rdv_host = ext_host if external else rdv_addr
     extra = config_parser.env_from_args(args)
     if (args.data_plane or "").lower() in ("xla", "auto"):
         # The jax.distributed coordination service runs inside rank 0's
@@ -301,7 +324,7 @@ def launch_job(args, command: List[str]) -> int:
     pumps: List[_OutputPump] = []
     try:
         for slot in slots:
-            env = _slot_env(slot, rdv_addr, port, extra,
+            env = _slot_env(slot, rdv_host, port, extra,
                             tpu_chip_binding=tpu_chip_binding,
                             job_host_slots=job_host_slots)
             proc = spawn_worker(slot, command, env)

@@ -16,6 +16,17 @@ labeled by rank; append ``?format=json`` for the raw per-rank snapshots),
 exchange anchor ``tools/trace_merge.py``'s clock alignment relies on).
 Both are unauthenticated read-only endpoints by design: a Prometheus
 scraper can't sign requests, and neither path can mutate the store.
+
+Survivability (docs/control_plane.md): with a journal directory
+configured (``HOROVOD_RENDEZVOUS_JOURNAL_DIR`` or the ``journal_dir``
+argument) the KV store write-ahead-journals every mutation, so a server
+SIGKILLed mid-job and restarted over the same directory replays to its
+exact pre-crash state — topology, epoch, leases, metrics keys.  Run
+``python -m horovod_tpu.runner.rendezvous`` for the standalone,
+supervisor-managed deployment (the launcher attaches to it via
+``HOROVOD_RENDEZVOUS_EXTERNAL=host:port``), and ``GET /__keys__/<scope>``
+(HMAC-signed like every KV op) enumerates a scope for the driver's lease
+scan and crash-recovery.
 """
 
 from __future__ import annotations
@@ -27,8 +38,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Tuple
 from urllib.parse import unquote
 
+from ..common import env as env_mod
+from ..common import faults
 from ..core import metrics as metrics_mod
-from ..transport.store import MemoryStore
+from ..transport.store import KEYS_PSEUDO_SCOPE, DurableMemoryStore
 
 RANK_AND_SIZE_SCOPE = "rank_and_size"
 
@@ -120,6 +133,10 @@ class _Handler(BaseHTTPRequestHandler):
         return False
 
     def do_GET(self):
+        # Chaos site for server-side read failures: hang/delay a serve, or
+        # (on the standalone server) action=exit for a mid-serve kill.
+        if faults.ACTIVE:
+            faults.inject("store.get_serve")
         if self._serve_special_get():
             return
         parsed = self._parse()
@@ -127,6 +144,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         scope, key = parsed
         if not self._authorized(b""):
+            return
+        if scope == KEYS_PSEUDO_SCOPE:
+            # GET /__keys__/<scope>: scope enumeration (signed — the key
+            # list leaks membership, unlike the aggregate /metrics view).
+            self._reply(json.dumps(sorted(
+                self.server.store_keys(key))).encode(), "application/json")
             return
         val = self.server.store_get(scope, key)
         if val is None:
@@ -158,13 +181,19 @@ class _KVServer(ThreadingHTTPServer):
     # gets RST at 16+ ranks (found by benchmarks/controller_bench.py).
     request_queue_size = 128
 
-    def __init__(self, addr, delete_hook=None, job_secret=None):
+    def __init__(self, addr, delete_hook=None, job_secret=None,
+                 journal_dir=None):
         super().__init__(addr, _Handler)
-        # Compose the canonical MemoryStore so storage semantics (keying,
-        # locking) live in exactly one place (transport/store.py).
-        self._store = MemoryStore()
+        # Compose the canonical store so storage semantics (keying,
+        # locking, journaling) live in exactly one place
+        # (transport/store.py); journal_dir=None means plain in-memory.
+        self._store = DurableMemoryStore(journal_dir)
         self._delete_hook = delete_hook
         self.job_secret = job_secret
+
+    def server_close(self):
+        super().server_close()
+        self._store.close()
 
     def store_set(self, scope: str, key: str, value: bytes) -> None:
         self._store.set(scope, key, value)
@@ -188,16 +217,22 @@ class RendezvousServer:
 
     def __init__(self, bind_addr: str = "0.0.0.0",
                  delete_hook: Optional[Callable[[str, str], None]] = None,
-                 job_secret: Optional[bytes] = None):
+                 job_secret: Optional[bytes] = None,
+                 journal_dir: Optional[str] = None):
         self._bind_addr = bind_addr
         self._server: Optional[_KVServer] = None
         self._thread: Optional[threading.Thread] = None
         self._delete_hook = delete_hook
         self._job_secret = job_secret
+        if journal_dir is None:
+            journal_dir = env_mod.get_str(
+                env_mod.HOROVOD_RENDEZVOUS_JOURNAL_DIR) or None
+        self._journal_dir = journal_dir
 
     def start(self, port: int = 0) -> int:
         self._server = _KVServer((self._bind_addr, port), self._delete_hook,
-                                 job_secret=self._job_secret)
+                                 job_secret=self._job_secret,
+                                 journal_dir=self._journal_dir)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="rendezvous-http", daemon=True)
         self._thread.start()
@@ -238,3 +273,91 @@ class RendezvousServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+
+
+class ExternalRendezvous:
+    """Driver-side handle on a rendezvous server in ANOTHER process
+    (``HOROVOD_RENDEZVOUS_EXTERNAL=host:port``): the same surface the
+    elastic driver uses on an in-process :class:`RendezvousServer`, with
+    every op going over the signed HTTP client — so a store op can now
+    FAIL (OSError), which is exactly the signal the driver's partitioned
+    mode keys off.  ``stop()`` is a no-op: the server's lifetime belongs
+    to its supervisor, which is the point — it outlives the launcher."""
+
+    def __init__(self, addr: str, port: int):
+        from ..transport.store import HTTPStoreClient
+
+        self.addr = addr
+        self._port = int(port)
+        self._client = HTTPStoreClient(addr, self._port)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def publish_slots(self, slots: List[dict]) -> None:
+        for slot in slots:
+            self.set(RANK_AND_SIZE_SCOPE,
+                     f"{slot['hostname']}:{slot['local_rank']}",
+                     json.dumps(slot).encode())
+
+    def set(self, scope: str, key: str, value: bytes) -> None:
+        self._client.set(scope, key, value)
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        return self._client.get(scope, key)
+
+    def keys(self, scope: str) -> List[str]:
+        return self._client.keys(scope)
+
+    def stop(self) -> None:
+        pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone journaled rendezvous server::
+
+        HOROVOD_SECRET_KEY=... python -m horovod_tpu.runner.rendezvous \\
+            --port 7010 --journal-dir /var/lib/hvd/rendezvous
+
+    The survivable deployment shape (docs/control_plane.md): run this
+    under a supervisor, point the launcher at it with
+    ``HOROVOD_RENDEZVOUS_EXTERNAL=host:port``, and a SIGKILL'd server
+    replays its journal on restart with no worker-visible state loss.
+    """
+    import argparse
+
+    from ..common import secret as secret_mod
+
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.runner.rendezvous",
+        description="standalone journaled rendezvous KV server")
+    parser.add_argument("--bind", default="0.0.0.0",
+                        help="address to bind (default 0.0.0.0)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="port to bind (default: ephemeral, printed)")
+    parser.add_argument("--journal-dir", default=None,
+                        help="journal/snapshot directory (default: "
+                             "HOROVOD_RENDEZVOUS_JOURNAL_DIR; empty = "
+                             "no durability)")
+    args = parser.parse_args(argv)
+
+    server = RendezvousServer(bind_addr=args.bind,
+                              job_secret=secret_mod.job_secret(),
+                              journal_dir=args.journal_dir)
+    port = server.start(args.port)
+    print(f"rendezvous serving on port {port}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

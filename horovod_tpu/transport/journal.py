@@ -1,0 +1,341 @@
+"""Write-ahead journal + compacted snapshots for the rendezvous KV store.
+
+The control plane's durability layer (docs/control_plane.md): every store
+mutation is appended to a journal file as a length-prefixed + crc32 record
+— the same frame discipline the wire transport (transport/tcp.py) and the
+checkpoint layer adopted in the integrity plane — and periodically the
+full KV map is compacted into a snapshot so the journal cannot grow
+without bound.  A restarted rendezvous server replays snapshot + journal
+back to its exact pre-crash state.
+
+On-disk layout (one directory per store)::
+
+    snap-00000003        newest compacted snapshot (generation 3)
+    journal-00000003     ops appended since that snapshot
+    snap-00000002        previous generation, kept until the next compaction
+    journal-00000002
+
+Every file is a sequence of frames ``<Q payload_len><I crc32(payload)>``
+followed by the payload.  A journal's first frame is the magic
+``HVDJRNL1``; each later frame is one op: ``<B op><I key_len>key[value]``
+with op 1 = SET, 2 = DELETE.  A snapshot is magic ``HVDSNAP1``, one SET
+frame per key, and the commit marker ``HVDSNAP-END`` — a snapshot without
+its end marker is an aborted compaction and is ignored by recovery.
+
+Crash-consistency invariants:
+
+- **Longest valid prefix**: a reader stops at the first frame whose
+  header is short, whose payload is short, or whose crc32 mismatches — a
+  torn final write (power loss mid-append) silently shortens the journal
+  by at most the op being written, never misparses.  Recovery truncates
+  the torn tail so later appends extend the valid prefix.
+- **Snapshot-then-switch**: a compaction writes ``snap-<g+1>`` to a temp
+  name, fsyncs, atomically publishes via ``os.replace`` (the checkpoint
+  plane's tmp+rename discipline), and only THEN starts ``journal-<g+1>``
+  and prunes generation g-1.  A crash mid-compaction leaves an invalid
+  (or absent) ``snap-<g+1>`` and recovery falls back to generation g,
+  which still holds every op.
+- **WAL ordering**: the store appends (and, under the default fsync
+  policy, syncs) the record BEFORE applying the op to memory, so a PUT
+  the server acknowledged is durable.
+
+Locking: :class:`StoreJournal` guards its file state with one private
+lock that is a **leaf** — no other lock in this package is ever acquired
+while holding it.  The store calls in holding its own condition lock, so
+the only order is store-lock → journal-lock, and lockdep
+(``HOROVOD_LOCK_DEBUG=1``) must keep reporting zero cycles through it.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..common.logging_util import get_logger
+
+log = get_logger("horovod_tpu.transport.journal")
+
+#: Frame header: payload length, crc32(payload) — the PR-4 wire shape.
+_HDR = struct.Struct("<QI")
+#: Op record prefix inside a frame payload: op byte, key length.
+_OP = struct.Struct("<BI")
+
+OP_SET = 1
+OP_DELETE = 2
+
+JOURNAL_MAGIC = b"HVDJRNL1"
+SNAP_MAGIC = b"HVDSNAP1"
+SNAP_END = b"HVDSNAP-END"
+
+#: Refuse to trust a length field past this: a corrupt header with a huge
+#: length must read as "torn frame", not attempt a giant allocation.
+_MAX_PAYLOAD = 256 * 1024 * 1024
+
+
+def pack_frame(payload: bytes) -> bytes:
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def iter_frames(blob: bytes) -> Iterator[Tuple[int, bytes]]:
+    """Yield ``(end_offset, payload)`` for every valid frame in order,
+    stopping at the first torn or corrupt one (longest-valid-prefix)."""
+    off = 0
+    n = len(blob)
+    while n - off >= _HDR.size:
+        length, crc = _HDR.unpack_from(blob, off)
+        start = off + _HDR.size
+        if length > _MAX_PAYLOAD or length > n - start:
+            return  # torn tail (or a corrupt length field)
+        payload = blob[start:start + length]
+        if zlib.crc32(payload) != crc:
+            return
+        off = start + length
+        yield off, payload
+
+
+def encode_op(op: int, key: str, value: bytes = b"") -> bytes:
+    kb = key.encode("utf-8")
+    return _OP.pack(op, len(kb)) + kb + value
+
+
+def decode_op(payload: bytes) -> Tuple[int, str, bytes]:
+    op, klen = _OP.unpack_from(payload)
+    key_end = _OP.size + klen
+    if key_end > len(payload):
+        raise ValueError("op record shorter than its key length")
+    key = payload[_OP.size:key_end].decode("utf-8")
+    return op, key, bytes(payload[key_end:])
+
+
+class StoreJournal:
+    """Journal + snapshot manager for one KV store directory.
+
+    All mutating methods are expected to be called with the owning
+    store's lock held (the store is the serialization point for op
+    order); the internal ``_lock`` only protects the file handle against
+    a concurrent ``close()`` and keeps compaction atomic, and is a leaf.
+    """
+
+    def __init__(self, dirpath: str, fsync: bool = True,
+                 snapshot_every: int = 512):
+        self._dir = dirpath
+        self._fsync = fsync
+        self._snapshot_every = max(1, int(snapshot_every))
+        self._lock = threading.Lock()  # LEAF — see module docstring
+        self._fh = None
+        self._gen = 0
+        self._ops_since_snap = 0
+        os.makedirs(dirpath, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------
+
+    def _snap_path(self, gen: int) -> str:
+        return os.path.join(self._dir, f"snap-{gen:08d}")
+
+    def _journal_path(self, gen: int) -> str:
+        return os.path.join(self._dir, f"journal-{gen:08d}")
+
+    def _generations(self) -> List[int]:
+        gens = set()
+        for name in os.listdir(self._dir):
+            for prefix in ("snap-", "journal-"):
+                if name.startswith(prefix) and not name.endswith(".tmp"):
+                    try:
+                        gens.add(int(name[len(prefix):]))
+                    except ValueError:
+                        continue
+        return sorted(gens)
+
+    # -- recovery ------------------------------------------------------
+
+    def recover(self) -> Dict[str, bytes]:
+        """Replay to the pre-crash KV state and arm the journal for
+        appends (truncating any torn tail first).  Call exactly once,
+        before the first append."""
+        with self._lock:
+            state, gen, valid_len, nops = self._recover_locked()
+            self._gen = gen
+            jpath = self._journal_path(gen)
+            if os.path.exists(jpath) and os.path.getsize(jpath) > valid_len:
+                torn = os.path.getsize(jpath) - valid_len
+                log.warning("journal %s: truncating %d-byte torn tail "
+                            "(replayed %d ops)", jpath, torn, nops)
+                with open(jpath, "r+b") as f:
+                    f.truncate(valid_len)
+                    f.flush()
+                    os.fsync(f.fileno())
+            self._open_journal_locked(gen)
+            self._ops_since_snap = nops
+            if state or nops:
+                log.info("rendezvous journal recovered: generation %d, "
+                         "%d keys, %d journal ops", gen, len(state), nops)
+            return state
+
+    def _recover_locked(self) -> Tuple[Dict[str, bytes], int, int, int]:
+        for gen in sorted(self._generations(), reverse=True) or [0]:
+            if gen == 0:
+                base: Optional[Dict[str, bytes]] = {}
+            else:
+                base = self._read_snapshot(gen)
+                if base is None:
+                    # Aborted compaction (no end marker / torn): the
+                    # previous generation still holds every op.
+                    log.warning("snapshot generation %d invalid; falling "
+                                "back to generation %d", gen, gen - 1)
+                    continue
+            state, valid_len, nops = self._replay_journal(gen, base)
+            return state, gen, valid_len, nops
+        return {}, 0, 0, 0
+
+    def _read_snapshot(self, gen: int) -> Optional[Dict[str, bytes]]:
+        path = self._snap_path(gen)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        frames = [p for _, p in iter_frames(blob)]
+        if len(frames) < 2 or frames[0] != SNAP_MAGIC \
+                or frames[-1] != SNAP_END:
+            return None
+        state: Dict[str, bytes] = {}
+        for payload in frames[1:-1]:
+            try:
+                op, key, value = decode_op(payload)
+            except (ValueError, struct.error):
+                return None
+            if op != OP_SET:
+                return None
+            state[key] = value
+        return state
+
+    def _replay_journal(self, gen: int, base: Dict[str, bytes]
+                        ) -> Tuple[Dict[str, bytes], int, int]:
+        """Apply the journal's longest valid prefix over ``base``; returns
+        (state, byte length of the valid prefix, ops replayed)."""
+        path = self._journal_path(gen)
+        state = dict(base)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return state, 0, 0
+        valid_len = 0
+        nops = 0
+        first = True
+        for end, payload in iter_frames(blob):
+            if first:
+                first = False
+                if payload != JOURNAL_MAGIC:
+                    break  # foreign file: replay nothing, rewrite below
+                valid_len = end
+                continue
+            try:
+                op, key, value = decode_op(payload)
+            except (ValueError, struct.error):
+                break
+            if op == OP_SET:
+                state[key] = value
+            elif op == OP_DELETE:
+                state.pop(key, None)
+            else:
+                break
+            valid_len = end
+            nops += 1
+        return state, valid_len, nops
+
+    # -- append path ---------------------------------------------------
+
+    def _open_journal_locked(self, gen: int) -> None:
+        self._fh = open(self._journal_path(gen), "ab")
+        if self._fh.tell() == 0:
+            self._fh.write(pack_frame(JOURNAL_MAGIC))
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
+        self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
+
+    def append_set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            if self._fh is None:
+                return  # closed (server shutdown race): drop silently
+            self._fh.write(pack_frame(encode_op(OP_SET, key, value)))
+            self._sync_locked()
+            self._ops_since_snap += 1
+
+    def append_delete(self, key: str) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(pack_frame(encode_op(OP_DELETE, key)))
+            self._sync_locked()
+            self._ops_since_snap += 1
+
+    def maybe_compact(self, state: Dict[str, bytes]) -> bool:
+        """Compact when the op budget is spent; ``state`` is the full
+        post-op KV map (the caller holds the store lock, so it cannot
+        move underneath).  Returns whether a compaction ran."""
+        with self._lock:
+            if self._fh is None or \
+                    self._ops_since_snap < self._snapshot_every:
+                return False
+            self._compact_locked(state)
+            return True
+
+    def _compact_locked(self, state: Dict[str, bytes]) -> None:
+        new_gen = self._gen + 1
+        snap = self._snap_path(new_gen)
+        tmp = snap + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(pack_frame(SNAP_MAGIC))
+            for key in sorted(state):
+                f.write(pack_frame(encode_op(OP_SET, key, state[key])))
+            f.write(pack_frame(SNAP_END))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, snap)
+        self._fsync_dir()
+        # Publish point passed: switch journals, then prune generations
+        # older than the one we just superseded (keep 2: a torn NEW
+        # snapshot must still find a complete predecessor).
+        self._fh.close()
+        self._gen = new_gen
+        self._open_journal_locked(new_gen)
+        self._ops_since_snap = 0
+        for gen in self._generations():
+            if gen < new_gen - 1:
+                for path in (self._snap_path(gen), self._journal_path(gen)):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        log.debug("compacted rendezvous journal to generation %d "
+                  "(%d keys)", new_gen, len(state))
+
+    def _fsync_dir(self) -> None:
+        """Make the rename durable (POSIX: the directory entry needs its
+        own fsync); best-effort on filesystems without directory fds."""
+        try:
+            fd = os.open(self._dir, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._sync_locked()
+                    self._fh.close()
+                finally:
+                    self._fh = None

@@ -1,0 +1,283 @@
+"""Control-plane survivability: journal replay, torn-write recovery,
+server restart, and driver crash-recovery (docs/control_plane.md).
+
+The fast, in-process half of the survivability proof; the end-to-end
+SIGKILL-and-restart chaos runs live in tests/test_fault_injection.py's
+chaos lane.
+"""
+
+import json
+import random
+import shutil
+
+import pytest
+
+from horovod_tpu.transport.journal import (
+    OP_DELETE,
+    OP_SET,
+    decode_op,
+    encode_op,
+    iter_frames,
+    pack_frame,
+)
+from horovod_tpu.transport.store import (
+    LEASE_SCOPE,
+    DurableMemoryStore,
+    HTTPStoreClient,
+)
+from horovod_tpu.runner.rendezvous import ExternalRendezvous, RendezvousServer
+
+
+# ---------------------------------------------------------------------------
+# frame / op encoding
+
+
+class TestFrames:
+    def test_op_roundtrip(self):
+        for op, key, value in [(OP_SET, "scope/key", b"value"),
+                               (OP_SET, "a/b", b""),
+                               (OP_DELETE, "metrics/rank-0", b"")]:
+            assert decode_op(encode_op(op, key, value)) == (op, key, value)
+
+    def test_iter_frames_stops_at_crc_mismatch(self):
+        blob = pack_frame(b"one") + pack_frame(b"two")
+        corrupt = bytearray(blob)
+        corrupt[-1] ^= 0xFF  # flip a byte of the second payload
+        assert [p for _, p in iter_frames(bytes(corrupt))] == [b"one"]
+
+    def test_iter_frames_rejects_absurd_length(self):
+        import struct
+
+        # A corrupt header claiming a huge payload must read as "torn",
+        # not attempt the allocation.
+        blob = struct.pack("<QI", 2 ** 62, 0) + b"x" * 64
+        assert list(iter_frames(blob)) == []
+
+
+# ---------------------------------------------------------------------------
+# journal replay exactness
+
+
+def _apply_random_ops(store, mirror, rng, n_ops):
+    scopes = ["rank_and_size", "lease", "metrics"]
+    for _ in range(n_ops):
+        scope = rng.choice(scopes)
+        key = f"k{rng.randrange(12)}"
+        if rng.random() < 0.25 and mirror:
+            flat = rng.choice(sorted(mirror))
+            s, k = flat.split("/", 1)
+            store.delete(s, k)
+            mirror.pop(flat, None)
+        else:
+            value = bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(0, 64)))
+            store.set(scope, key, value)
+            mirror[f"{scope}/{key}"] = value
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_replay_equals_precrash_state_random_ops(tmp_path, seed):
+    """Property: for a randomized op sequence (sets/deletes across scopes,
+    with compactions forced every few ops), a fresh store over the same
+    directory replays to the EXACT pre-close state."""
+    rng = random.Random(seed)
+    jdir = str(tmp_path / f"j{seed}")
+    store = DurableMemoryStore(jdir, fsync=False,
+                               snapshot_every=rng.choice([3, 7, 1000]))
+    mirror = {}
+    _apply_random_ops(store, mirror, rng, 120)
+    store.close()
+
+    recovered = DurableMemoryStore(jdir, fsync=False)
+    assert recovered._data == mirror
+    recovered.close()
+
+
+def test_torn_write_every_offset_recovers_longest_prefix(tmp_path):
+    """Truncate the journal at EVERY byte offset of the final record: the
+    replay must recover exactly the state before that record — never
+    misparse, never lose an earlier op (the PR-4 every-prefix fuzz
+    discipline applied to the WAL)."""
+    jdir = tmp_path / "j"
+    store = DurableMemoryStore(str(jdir), fsync=False,
+                               snapshot_every=10 ** 9)
+    store.set("s", "a", b"alpha")
+    store.set("s", "b", b"beta")
+    store.delete("s", "a")
+    state_before_final = dict(store._data)
+    store.set("s", "final", b"the-final-record-payload")
+    state_with_final = dict(store._data)
+    store.close()
+
+    jpath = jdir / "journal-00000000"
+    blob = jpath.read_bytes()
+    ends = [end for end, _ in iter_frames(blob)]
+    assert ends[-1] == len(blob)
+    final_start = ends[-2]
+
+    # Sanity: the untruncated journal replays the full state.
+    full = DurableMemoryStore(str(jdir), fsync=False)
+    assert full._data == state_with_final
+    full.close()
+
+    for cut in range(final_start, len(blob)):
+        case = tmp_path / f"cut{cut}"
+        shutil.copytree(jdir, case)
+        with open(case / "journal-00000000", "r+b") as f:
+            f.truncate(cut)
+        recovered = DurableMemoryStore(str(case), fsync=False)
+        assert recovered._data == state_before_final, f"cut at {cut}"
+        # The torn tail was truncated away: appending must extend the
+        # valid prefix, not concatenate after garbage.
+        recovered.set("s", "post", b"post-recovery")
+        recovered.close()
+        again = DurableMemoryStore(str(case), fsync=False)
+        assert again._data == {**state_before_final,
+                               "s/post": b"post-recovery"}, f"cut at {cut}"
+        again.close()
+        shutil.rmtree(case)
+
+
+def test_aborted_compaction_falls_back_to_previous_generation(tmp_path):
+    """A snapshot without its commit marker (crash mid-compaction) is
+    ignored; the previous generation still holds every op."""
+    jdir = tmp_path / "j"
+    store = DurableMemoryStore(str(jdir), fsync=False, snapshot_every=5)
+    for i in range(8):  # compacts at op 5 -> generation 1
+        store.set("s", f"k{i}", b"v%d" % i)
+    expect = dict(store._data)
+    store.close()
+    assert (jdir / "snap-00000001").exists()
+
+    # Simulate a crash mid-compaction to generation 2: valid frames but
+    # no SNAP_END commit marker, and no journal-2 yet.
+    torn = pack_frame(b"HVDSNAP1") + pack_frame(
+        encode_op(OP_SET, "s/k0", b"stale"))
+    (jdir / "snap-00000002").write_bytes(torn)
+
+    recovered = DurableMemoryStore(str(jdir), fsync=False)
+    assert recovered._data == expect
+    recovered.close()
+
+
+def test_journal_disabled_is_plain_memory_store(tmp_path):
+    store = DurableMemoryStore(None)
+    store.set("s", "k", b"v")
+    assert store.get("s", "k") == b"v"
+    assert store.pop("s", "k") == b"v"
+    assert store.pop("s", "k") is None
+    store.close()
+    assert list(tmp_path.iterdir()) == []
+
+
+# ---------------------------------------------------------------------------
+# server restart + keys endpoint
+
+
+def test_server_restart_replays_state_and_serves_keys(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_SECRET_KEY", "cp-test-secret")
+    jdir = str(tmp_path / "j")
+    server = RendezvousServer("127.0.0.1", job_secret=b"cp-test-secret",
+                              journal_dir=jdir)
+    port = server.start()
+    client = HTTPStoreClient("127.0.0.1", port)
+    client.set("rank_and_size", "localhost:0", b'{"rank": 0}')
+    client.set(LEASE_SCOPE, "localhost:0", b'{"renewals": 3}')
+    client.set(LEASE_SCOPE, "otherhost:0", b'{"renewals": 1}')
+    assert client.keys(LEASE_SCOPE) == ["localhost:0", "otherhost:0"]
+    server.stop()  # SIGKILL-alike for state purposes: nothing flushed late
+
+    server2 = RendezvousServer("127.0.0.1", job_secret=b"cp-test-secret",
+                               journal_dir=jdir)
+    port2 = server2.start()
+    client2 = HTTPStoreClient("127.0.0.1", port2)
+    assert client2.get("rank_and_size", "localhost:0") == b'{"rank": 0}'
+    assert client2.keys(LEASE_SCOPE) == ["localhost:0", "otherhost:0"]
+    assert client2.keys("empty_scope") == []
+    server2.stop()
+
+
+def test_external_rendezvous_adapter_matches_server_surface(tmp_path,
+                                                            monkeypatch):
+    monkeypatch.setenv("HOROVOD_SECRET_KEY", "cp-test-secret")
+    server = RendezvousServer("127.0.0.1", job_secret=b"cp-test-secret")
+    port = server.start()
+    ext = ExternalRendezvous("127.0.0.1", port)
+    assert ext.port == port
+    ext.publish_slots([{
+        "hostname": "localhost", "rank": 0, "local_rank": 0,
+        "cross_rank": 0, "size": 1, "local_size": 1, "cross_size": 1,
+        "epoch": 0,
+    }])
+    raw = ext.get("rank_and_size", "localhost:0")
+    assert json.loads(raw.decode())["rank"] == 0
+    assert ext.keys("rank_and_size") == ["localhost:0"]
+    ext.stop()  # no-op: must NOT kill the external server
+    assert ext.get("rank_and_size", "localhost:0") is not None
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# driver crash-recovery
+
+
+def test_driver_recovers_epoch_and_readopts_leased_workers(tmp_path,
+                                                           monkeypatch):
+    """A restarted driver over a journaled store re-adopts the epoch and
+    every live-leased identity instead of respawning the world."""
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import parse_hosts
+
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    jdir = str(tmp_path / "j")
+    hosts = "localhost:1,127.0.0.1:1"
+
+    server = RendezvousServer("127.0.0.1", journal_dir=jdir)
+    server.start()
+    spawned = []
+    driver = ElasticDriver(server,
+                           HostManager(FixedHosts(parse_hosts(hosts))),
+                           min_np=2, lease_timeout=60.0)
+    driver.start(lambda slot, epoch: spawned.append(
+        (f"{slot.hostname}:{slot.local_rank}", epoch)))
+    assert sorted(spawned) == [("127.0.0.1:0", 0), ("localhost:0", 0)]
+    # Workers renew their leases (what the metrics pusher does).
+    for identity in ("localhost:0", "127.0.0.1:0"):
+        server.set(LEASE_SCOPE, identity,
+                   json.dumps({"renewals": 1, "epoch": 0}).encode())
+    driver.stop()
+    driver._discovery_thread.join(timeout=10)
+    server.stop()  # driver + server die together (launcher crash)
+
+    server2 = RendezvousServer("127.0.0.1", journal_dir=jdir)
+    server2.start()
+    spawned2 = []
+    driver2 = ElasticDriver(server2,
+                            HostManager(FixedHosts(parse_hosts(hosts))),
+                            min_np=2, lease_timeout=60.0)
+    assert driver2.recover_from_store() is True
+    assert driver2.epoch == driver.epoch
+    driver2.start(lambda slot, epoch: spawned2.append(
+        (f"{slot.hostname}:{slot.local_rank}", epoch)))
+    # Live-leased workers re-adopted: NOBODY respawned, epoch unchanged.
+    assert spawned2 == []
+    assert driver2.epoch == driver.epoch
+    driver2.stop()
+    driver2._discovery_thread.join(timeout=10)
+    server2.stop()
+
+
+def test_driver_recover_is_noop_on_fresh_store(tmp_path):
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import parse_hosts
+
+    server = RendezvousServer("127.0.0.1")
+    server.start()
+    driver = ElasticDriver(server,
+                           HostManager(FixedHosts(parse_hosts("localhost:1"))),
+                           min_np=1)
+    assert driver.recover_from_store() is False
+    assert driver.epoch == 0
+    server.stop()

@@ -10,8 +10,10 @@ Spec grammar and site list: ``docs/fault_injection.md`` /
 ``horovod_tpu/common/faults.py``.
 """
 
+import json
 import os
 import re
+import socket
 import subprocess
 import sys
 import threading
@@ -22,7 +24,12 @@ import pytest
 from horovod_tpu.common import faults
 from horovod_tpu.common.exceptions import FaultInjectedError
 
-from .helpers import REPO_ROOT, run_distributed
+from .helpers import (
+    REPO_ROOT,
+    release_reservations,
+    reserve_port,
+    run_distributed,
+)
 
 pytestmark = pytest.mark.chaos
 
@@ -507,3 +514,326 @@ def test_elastic_recovers_from_injected_rank_death(tmp_path):
     assert "ELASTIC_DONE" in proc.stdout, proc.stdout[-2000:]
     assert "size=2" in proc.stdout, "never ran at full size"
     assert "size=1" in proc.stdout, "never recovered at reduced size"
+
+
+# ---------------------------------------------------------------------------
+# control-plane survivability (docs/control_plane.md)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_dead_worker_lease_expiry_advances_epoch_within_one_tick():
+    """A worker whose PROCESS is alive but whose lease stops renewing is
+    genuinely dead to the job: the driver must declare it dead and advance
+    the epoch on the first tick after expiry — the liveness half of
+    dead-vs-partitioned (a store outage, by contrast, must freeze this
+    judgment; tested in the SIGKILL run below)."""
+    from horovod_tpu.core import metrics as metrics_mod
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import parse_hosts
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    from horovod_tpu.transport.store import LEASE_SCOPE
+
+    server = RendezvousServer("127.0.0.1")
+    server.start()
+    spawned = []
+    driver = ElasticDriver(
+        server,
+        HostManager(FixedHosts(parse_hosts("localhost:1,127.0.0.1:1"))),
+        min_np=2, lease_timeout=1.5)
+    stop_renewals = threading.Event()
+
+    def renew_survivor():
+        n = 0
+        while not stop_renewals.is_set():
+            n += 1  # the VALUE must change: freshness is change-based
+            server.set(LEASE_SCOPE, "localhost:0",
+                       json.dumps({"rank": 0, "epoch": 0,
+                                   "renewals": n}).encode())
+            time.sleep(0.3)
+
+    expirations_before = metrics_mod.registry.get_counter(
+        "lease_expirations_total")
+    try:
+        driver.start(lambda slot, epoch: spawned.append(
+            (f"{slot.hostname}:{slot.local_rank}", epoch)))
+        assert driver.epoch == 0 and len(spawned) == 2
+        threading.Thread(target=renew_survivor, daemon=True).start()
+        # The doomed worker posts exactly ONE lease, then goes silent —
+        # no exit event ever reaches the driver.
+        server.set(LEASE_SCOPE, "127.0.0.1:0",
+                   json.dumps({"rank": 1, "epoch": 0,
+                               "renewals": 1}).encode())
+        t0 = time.monotonic()
+        while driver.epoch == 0 and time.monotonic() - t0 < 30:
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+        assert driver.epoch >= 1, "lease expiry never advanced the epoch"
+        # Bound: baseline sighting (≤1 tick) + timeout (1.5 s) + one
+        # judgment tick (1 s) + scheduling slack.  Anything near the 15 s
+        # production default means expiry didn't drive the advance.
+        assert elapsed < 10.0, f"epoch advance took {elapsed:.1f}s"
+        # The dead identity was respawned at the new epoch; the renewing
+        # survivor was left alone.
+        deadline = time.monotonic() + 10
+        while ("127.0.0.1:0", 1) not in spawned and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ("127.0.0.1:0", 1) in spawned, spawned
+        assert ("localhost:0", 1) not in spawned, spawned
+        assert metrics_mod.registry.get_counter(
+            "lease_expirations_total") >= expirations_before + 1
+    finally:
+        stop_renewals.set()
+        driver.stop()
+        driver._discovery_thread.join(timeout=10)
+        server.stop()
+
+
+_SURVIVABILITY_TRAIN = """
+import time
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0, params=np.zeros(4, np.float32))
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 80:
+        grad = hvd.allreduce(
+            np.full(4, float(state.batch + 1), np.float32),
+            op=hvd.Sum, name="g")
+        state.params = state.params + np.asarray(grad)
+        if state.batch % 5 == 0:
+            print(f"BATCH {state.batch} rank={hvd.rank()}", flush=True)
+        state.batch += 1
+        state.commit()
+        time.sleep(0.1)
+
+train(state)
+print("FINAL_PARAMS r%d %s" % (
+    hvd.rank(), np.asarray(state.params).tobytes().hex()), flush=True)
+hvd.shutdown()
+"""
+
+
+def _spawn_external_server(port, journal_dir, env):
+    """Start the standalone journaled rendezvous server and wait for it
+    to accept connections."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.rendezvous",
+         "--bind", "127.0.0.1", "--port", str(port),
+         "--journal-dir", str(journal_dir)],
+        cwd=REPO_ROOT, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return proc
+        except OSError:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("standalone rendezvous server never came up")
+
+
+def _pump(stream, sink):
+    for line in iter(stream.readline, ""):
+        sink.append(line)
+    stream.close()
+
+
+def _run_survivable_job(tmp_path, kill_server):
+    """np=2 elastic job against an EXTERNAL journaled rendezvous server;
+    optionally SIGKILL the server mid-train and restart it over the same
+    journal ~2 s later.  Returns (params_hex, stdout, stderr)."""
+    label = "kill" if kill_server else "clean"
+    jdir = tmp_path / f"journal_{label}"
+    port = reserve_port()
+    release_reservations()  # hand the port to the server child
+
+    env = os.environ.copy()
+    env.update(_FAST_DEADLINE)
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    env["HOROVOD_LOG_LEVEL"] = "info"
+    env["HOROVOD_SECRET_KEY"] = "survivability-chaos"
+    env["HOROVOD_METRICS_PUSH_SECS"] = "0.5"  # lease-renewal cadence
+    env["HOROVOD_RENDEZVOUS_EXTERNAL"] = f"127.0.0.1:{port}"
+
+    disc = tmp_path / f"discover_{label}.sh"
+    disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+    disc.chmod(0o755)
+    train = tmp_path / f"train_{label}.py"
+    train.write_text(_SURVIVABILITY_TRAIN)
+
+    server = _spawn_external_server(port, jdir, env)
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "2",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    out_lines, err_lines = [], []
+    pumps = [threading.Thread(target=_pump, args=(launcher.stdout, out_lines),
+                              daemon=True),
+             threading.Thread(target=_pump, args=(launcher.stderr, err_lines),
+                              daemon=True)]
+    for t in pumps:
+        t.start()
+    try:
+        if kill_server:
+            # Wait until BOTH ranks are demonstrably past init and
+            # training (a kill during init would be a different test).
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                text = "".join(out_lines)
+                if re.search(r"BATCH \d+ rank=0", text) and \
+                        re.search(r"BATCH \d+ rank=1", text):
+                    break
+                if launcher.poll() is not None:
+                    break
+                time.sleep(0.2)
+            else:
+                raise RuntimeError("ranks never reached training")
+            server.kill()  # SIGKILL: no flush, no goodbye
+            server.wait()
+            time.sleep(2.0)  # a real supervisor restart delay
+            server = _spawn_external_server(port, jdir, env)
+        rc = launcher.wait(timeout=300)
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
+            launcher.wait()
+        server.kill()
+        server.wait()
+    for t in pumps:
+        t.join(timeout=10)
+    stdout, stderr = "".join(out_lines), "".join(err_lines)
+    assert rc == 0, (stdout[-2000:], stderr[-2000:])
+    params = dict(re.findall(r"FINAL_PARAMS r(\d+) ([0-9a-f]+)", stdout))
+    assert set(params) == {"0", "1"}, stdout[-2000:]
+    assert params["0"] == params["1"], "ranks diverged"
+    return params["0"], stdout, stderr
+
+
+@pytest.mark.timeout(600)
+def test_rendezvous_server_sigkill_restart_bit_identical(tmp_path):
+    """The headline survivability proof: SIGKILL the external rendezvous
+    server mid-train and restart it over the same journal — the np=2 job
+    rides out the outage (best-effort pushes, partitioned-mode driver),
+    reattaches, and converges BIT-identical to a no-fault run with ZERO
+    epoch advances."""
+    clean, _, _ = _run_survivable_job(tmp_path, kill_server=False)
+    killed, _, stderr = _run_survivable_job(tmp_path, kill_server=True)
+    assert killed == clean, \
+        "post-restart run diverged from the no-fault run"
+    # Zero epoch bumps: the outage must read as partitioned, never as
+    # dead workers.
+    assert "advancing epoch" not in stderr, stderr[-3000:]
+    # And the outage actually happened and healed — this test must not
+    # pass vacuously if the kill lands in a blind spot.
+    assert "unreachable" in stderr, stderr[-3000:]
+    assert "reachable again" in stderr, stderr[-3000:]
+
+
+_STATIC_SURVIVABILITY_TRAIN = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import time
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+params = np.zeros(4, dtype=np.float32)
+for batch in range(30):
+    g = hvd.allreduce(np.full(4, batch + 1, dtype=np.float32),
+                      name="g%d" % batch, average=False)
+    params += np.asarray(g)
+    print("BATCH %d rank=%d" % (batch, hvd.rank()), flush=True)
+    time.sleep(0.1)
+print("FINAL_PARAMS r%d %s" % (
+    hvd.rank(), params.tobytes().hex()), flush=True)
+hvd.shutdown()
+"""
+
+
+@pytest.mark.timeout(300)
+def test_static_launch_attaches_external_server_and_survives_restart(
+        tmp_path):
+    """HOROVOD_RENDEZVOUS_EXTERNAL on the PLAIN (non-elastic) launch
+    path: the static launcher must attach to the standalone journaled
+    server instead of starting its own, the np=2 job must ride out a
+    SIGKILL+restart of that server mid-train, and the restarted server's
+    journal must replay the slot table the launcher published."""
+    jdir = tmp_path / "journal_static"
+    port = reserve_port()
+    release_reservations()
+
+    env = os.environ.copy()
+    env.update(_FAST_DEADLINE)
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    env["HOROVOD_SECRET_KEY"] = "survivability-chaos"
+    env["HOROVOD_METRICS_PUSH_SECS"] = "0.5"
+    env["HOROVOD_RENDEZVOUS_EXTERNAL"] = f"127.0.0.1:{port}"
+    train = tmp_path / "train_static.py"
+    train.write_text(_STATIC_SURVIVABILITY_TRAIN)
+
+    server = _spawn_external_server(port, jdir, env)
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out_lines = []
+    pump = threading.Thread(target=_pump, args=(launcher.stdout, out_lines),
+                            daemon=True)
+    pump.start()
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            text = "".join(out_lines)
+            if re.search(r"BATCH \d+ rank=0", text) and \
+                    re.search(r"BATCH \d+ rank=1", text):
+                break
+            if launcher.poll() is not None:
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("ranks never reached training")
+        server.kill()
+        server.wait()
+        time.sleep(1.0)
+        server = _spawn_external_server(port, jdir, env)
+        rc = launcher.wait(timeout=180)
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
+            launcher.wait()
+        server.kill()
+        server.wait()
+    pump.join(timeout=10)
+    stdout = "".join(out_lines)
+    assert rc == 0, stdout[-3000:]
+    params = dict(re.findall(r"FINAL_PARAMS r(\d+) ([0-9a-f]+)", stdout))
+    assert set(params) == {"0", "1"} and params["0"] == params["1"], \
+        stdout[-2000:]
+    # The launcher really went THROUGH the external server: its published
+    # slot table (and both workers' leases) replay from the journal.
+    from horovod_tpu.transport.store import LEASE_SCOPE, DurableMemoryStore
+    store = DurableMemoryStore(str(jdir))
+    try:
+        assert sorted(store.keys("rank_and_size")) == \
+            ["localhost:0", "localhost:1"]
+        assert len(store.keys(LEASE_SCOPE)) == 2
+    finally:
+        store.close()
