@@ -24,6 +24,8 @@ Modes::
     python benchmarks/allreduce_bench.py --crc-sweep      # CRC on/off ratio
     python benchmarks/allreduce_bench.py --segment-sweep 65536 262144 ...
                                                           # pipeline knob sweep
+    python benchmarks/allreduce_bench.py --compression-sweep
+                                          # none/fp16/bf16 × CRC on/off
 
 ``--out FILE`` writes the result records as a JSON artifact (the segment
 sweep's canonical home is ``benchmarks/results/ring_segment_sweep.json``).
@@ -134,6 +136,12 @@ def main() -> int:
                         "(interleaved) and report the overhead ratio — "
                         "the observability plane's ±10%% guard "
                         "(docs/observability.md)")
+    p.add_argument("--compression-sweep", action="store_true",
+                   help="sweep HOROVOD_WIRE_COMPRESSION none/fp16/bf16 × "
+                        "HOROVOD_WIRE_CRC on/off (interleaved) and report "
+                        "per-variant step time + speedup vs uncompressed "
+                        "(canonical artifact: "
+                        "benchmarks/results/ring_compression_r9.json)")
     p.add_argument("--out", type=str, default=None,
                    help="write result records to this JSON file")
     args = p.parse_args()
@@ -184,6 +192,39 @@ def main() -> int:
             })
             results.append(rec)
             print(json.dumps(rec), flush=True)
+    elif args.compression_sweep:
+        try:
+            import ml_dtypes  # noqa: F401
+            comp_modes = ["none", "fp16", "bf16"]
+        except ImportError:
+            comp_modes = ["none", "fp16"]
+        for nbytes in args.sizes:
+            for np_ in args.world_sizes:
+                variants = [
+                    (f"{mode}/crc-{crc}",
+                     {"HOROVOD_WIRE_COMPRESSION": mode,
+                      "HOROVOD_WIRE_CRC": "1" if crc == "on" else "0"})
+                    for mode in comp_modes
+                    for crc in ("on", "off")
+                ]
+                medians, samples = _interleaved_medians(
+                    variants, args.repeats, nbytes, np_, args.rounds)
+                base = medians["none/crc-on"]
+                for key, _ in variants:
+                    mode, crc = key.split("/crc-")
+                    rec = _record(nbytes, np_, medians[key])
+                    rec.update({
+                        "metric": "ring_compression_sweep",
+                        "compression": mode,
+                        "wire_crc": crc,
+                        "speedup_vs_none_crc_on": round(
+                            base / medians[key], 3),
+                        "samples_ms": [round(s * 1e3, 3)
+                                       for s in samples[key]],
+                        "repeats": args.repeats,
+                    })
+                    results.append(rec)
+                    print(json.dumps(rec), flush=True)
     elif args.crc_sweep:
         for nbytes in args.sizes:
             for np_ in args.world_sizes:

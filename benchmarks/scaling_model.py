@@ -66,8 +66,14 @@ def _load_json(name):
 
 def project(step_ms: float, grad_bytes: int, n: int, busbw_gbs: float,
             cycle_ms: float, dispatch_ms: float,
-            wfbp_overhead_ms: float) -> dict:
-    t_comm = 2 * (n - 1) / n * grad_bytes / (busbw_gbs * 1e9) * 1e3  # ms
+            wfbp_overhead_ms: float, compression_factor: float = 1.0)\
+        -> dict:
+    # Cast-on-the-wire compression (docs/data_plane.md) divides the bytes
+    # crossing the wire — fp16/bf16 on f32 grads is factor 2 — while the
+    # cast itself runs at memory bandwidth, far above wire busbw, so the
+    # model folds it entirely into t_comm.
+    wire_bytes = grad_bytes / compression_factor
+    t_comm = 2 * (n - 1) / n * wire_bytes / (busbw_gbs * 1e9) * 1e3  # ms
     backward_ms = step_ms * 2 / 3
     jit_exposed = max(0.0, t_comm - backward_ms)
     # dispatch_ms (measured probe) already contains one full negotiation
@@ -92,8 +98,13 @@ def main() -> int:
                    help="effective per-chip allreduce busbw (v5e ICI)")
     p.add_argument("--chips", type=int, nargs="+",
                    default=[8, 16, 64, 256])
+    p.add_argument("--compression-factor", type=float, default=1.0,
+                   help="wire-byte divisor from HOROVOD_WIRE_COMPRESSION "
+                        "(2.0 for fp16/bf16 on f32 grads, 1.0 = raw)")
     p.add_argument("--out", default=None)
     args = p.parse_args()
+    if args.compression_factor <= 0:
+        p.error("--compression-factor must be positive")
 
     # hot-path coordinator cycle p50 from the committed simulation
     # (benchmarks/results/controller_sim.json), by N
@@ -144,6 +155,7 @@ def main() -> int:
         "model": "analytic ring-allreduce projection (see module docstring)",
         "assumptions": {
             "busbw_gbs": args.busbw_gbs,
+            "compression_factor": args.compression_factor,
             "overlap_window": "2/3 of step (backward) for the jit and "
                               "eager-WFBP planes; none for the "
                               "post-backward eager plane",
@@ -158,7 +170,8 @@ def main() -> int:
     for name, (step_ms, grad_bytes) in MODELS.items():
         out["projections"][name] = [
             project(step_ms, grad_bytes, n, args.busbw_gbs,
-                    cycle.get(n, 2.0), dispatch_ms, wfbp_ms)
+                    cycle.get(n, 2.0), dispatch_ms, wfbp_ms,
+                    args.compression_factor)
             for n in args.chips
         ]
     line = json.dumps(out, indent=1)

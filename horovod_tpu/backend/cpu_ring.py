@@ -219,7 +219,9 @@ def _segment_elems(dtype: np.dtype) -> int:
 def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
                    send_arr: np.ndarray, recv_arr: np.ndarray,
                    reduce_to: Optional[np.ndarray] = None,
-                   wide: Optional[np.dtype] = None) -> None:
+                   wide: Optional[np.dtype] = None,
+                   compressor=None,
+                   fbm: Optional[FusionBufferManager] = None) -> None:
     """One zero-copy, segment-pipelined ring step — the primitive every
     host collective builds on.
 
@@ -237,11 +239,44 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
     every link frame identically; zero-size transfers send no frame at
     all (both sides agree they would be empty).  Sends are views over
     ``send_arr`` and receives land via ``recv_into`` — the hot loop's
-    only per-byte work is the numpy add."""
+    only per-byte work is the numpy add.
+
+    Integrity (``mesh.deferred_digests``, the default): segment frames go
+    out digest-DEFERRED — no inline CRC; both endpoints chain per-frame
+    digests off the serial path (sender right after the vectored write,
+    receiver on the helper thread in the reduce's shadow) and the step
+    closes with a digest-check frame each way, verified BEFORE this
+    function returns, so corrupt bytes never escape the collective.
+
+    Compression (``compressor`` + ``fbm``): each send segment is cast
+    into a persistent narrow arena and framed from there; receives land
+    in a narrow arena and widen during the reduce (or restore, allgather
+    phase) — ``recv_arr`` then only defines the logical element layout.
+    The frame header carries the wire dtype code, so a peer with a
+    different ``HOROVOD_WIRE_COMPRESSION`` aborts loudly."""
     seg = _segment_elems(send_arr.dtype)
     sn, rn = int(send_arr.size), int(recv_arr.size)
     n_send = -(-sn // seg)
     n_recv = -(-rn // seg)
+    deferred = mesh.deferred_digests
+    send_dig = mesh.new_digest() if deferred and n_send else None
+    recv_dig = mesh.new_digest() if deferred and n_recv else None
+    code = 0
+    send_stage = recv_stage = None
+    if compressor is not None:
+        code = compressor.code
+        wdt = compressor.wire_dtype
+        # Send staging is one segment (``send`` returns only after the
+        # kernel owns the bytes, so it is reusable); recv staging spans
+        # the whole transfer because segment k+1 lands while k is still
+        # being widened out of its slot.
+        sse, rse = min(seg, sn) if sn else 1, rn if rn else 1
+        if fbm is not None:
+            send_stage = fbm.get(wdt, sse, key="wire-send")
+            recv_stage = fbm.get(wdt, rse, key="wire-recv")
+        else:
+            send_stage = np.empty(sse, dtype=wdt)
+            recv_stage = np.empty(rse, dtype=wdt)
     prev_k = -1
     prev_h = None
     # One extra iteration drains the final outstanding receive — the
@@ -250,24 +285,44 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
         cur = None
         if k < n_recv:
             lo = k * seg
-            cur = mesh.recv_into_async(
-                prv, _byte_view(recv_arr[lo:min(rn, lo + seg)]))
+            hi = min(rn, lo + seg)
+            dest = recv_stage[lo:hi] if compressor is not None \
+                else recv_arr[lo:hi]
+            cur = mesh.recv_into_async(prv, _byte_view(dest),
+                                       digest=recv_dig, wire_dtype=code)
         if k < n_send:
             lo = k * seg
-            mesh.send(nxt, _byte_view(send_arr[lo:min(sn, lo + seg)]))
+            src = send_arr[lo:min(sn, lo + seg)]
+            if compressor is not None:
+                src = compressor.compress(src, send_stage)
+            mesh.send(nxt, _byte_view(src), digest=send_dig,
+                      wire_dtype=code)
         if prev_h is not None:
             prev_h.wait()
-            if reduce_to is not None:
-                lo = prev_k * seg
-                hi = min(rn, lo + seg)
+            lo = prev_k * seg
+            hi = min(rn, lo + seg)
+            if compressor is not None:
+                if reduce_to is not None:
+                    compressor.decompress_add(recv_stage[lo:hi],
+                                              reduce_to[lo:hi])
+                else:
+                    compressor.decompress_into(recv_stage[lo:hi],
+                                               recv_arr[lo:hi])
+            elif reduce_to is not None:
                 _widen_add(reduce_to[lo:hi], recv_arr[lo:hi], wide)
         prev_k, prev_h = k, cur
+    # Settle integrity at the step boundary: every posted recv has been
+    # waited above, so the check frame is next in FIFO order.
+    if send_dig is not None:
+        mesh.send_step_digest(nxt, send_dig, n_send)
+    if recv_dig is not None:
+        mesh.verify_step_digest(prv, recv_dig, n_recv)
 
 
 def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
                          idx: int, wide: np.dtype,
                          fbm: Optional[FusionBufferManager] = None,
-                         ) -> np.ndarray:
+                         compressor=None) -> np.ndarray:
     """Segment-pipelined ring reduce-scatter over ``group`` (ordered
     global ranks; ``idx`` is our position).  Returns the chunk bounds;
     afterwards position ``idx`` owns the fully reduced chunk
@@ -275,7 +330,9 @@ def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
 
     Incoming segments land in a persistent staging slice (never a
     per-step allocation) and the only per-byte work on the hot path is
-    the widened numpy add — zero heap copies per step."""
+    the widened numpy add — zero heap copies per step.  With
+    ``compressor``, segments travel narrow and the add widens straight
+    out of the narrow staging (``backend/compression.py``)."""
     g = len(group)
     bounds = _chunk_bounds(buf.size, g)
     nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
@@ -288,16 +345,22 @@ def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
         chunk = buf[bounds[recv_c]:bounds[recv_c + 1]]
         _ring_exchange(mesh, nxt, prv,
                        buf[bounds[send_c]:bounds[send_c + 1]],
-                       stage[:chunk.size], reduce_to=chunk, wide=wide)
+                       stage[:chunk.size], reduce_to=chunk, wide=wide,
+                       compressor=compressor, fbm=fbm)
     return bounds
 
 
 def _ring_allgather_chunks(mesh: TcpMesh, buf: np.ndarray, group: List[int],
-                           idx: int, bounds: np.ndarray) -> None:
+                           idx: int, bounds: np.ndarray,
+                           fbm: Optional[FusionBufferManager] = None,
+                           compressor=None) -> None:
     """Segment-pipelined ring allgather of per-position chunks (each
     position starts owning chunk ``(idx + 1) % g``, the reduce-scatter
     ownership).  Chunks land DIRECTLY in their final location in ``buf``
-    — no staging, no copy; the wire is the only mover."""
+    — no staging, no copy; the wire is the only mover.  With
+    ``compressor``, segments travel narrow and restore into place; the
+    caller must have quantized owned chunks first so ranks stay
+    bit-identical (``quantize_inplace``)."""
     g = len(group)
     nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
     for s in range(g - 1):
@@ -305,7 +368,23 @@ def _ring_allgather_chunks(mesh: TcpMesh, buf: np.ndarray, group: List[int],
         recv_c = (idx - s) % g
         _ring_exchange(mesh, nxt, prv,
                        buf[bounds[send_c]:bounds[send_c + 1]],
-                       buf[bounds[recv_c]:bounds[recv_c + 1]])
+                       buf[bounds[recv_c]:bounds[recv_c + 1]],
+                       compressor=compressor, fbm=fbm)
+
+
+def _quantize_owned(compressor, chunk: np.ndarray,
+                    fbm: Optional[FusionBufferManager]) -> None:
+    """Round-trip an owned (fully reduced) chunk through the wire dtype
+    before it is allgathered: receivers only ever see quantized values,
+    so the owner must not keep its extra wide precision — all ranks end
+    the allreduce bit-identical (the elastic recovery proof depends on
+    it)."""
+    if chunk.size == 0:
+        return
+    arena = fbm.get(compressor.wire_dtype, chunk.size, key="wire-quant") \
+        if fbm is not None \
+        else np.empty(chunk.size, dtype=compressor.wire_dtype)
+    compressor.quantize_inplace(chunk, arena)
 
 
 class RingAllreduce(CollectiveOp):
@@ -335,11 +414,20 @@ class RingAllreduce(CollectiveOp):
         return Status.OK()
 
     def _ring_allreduce(self, buf: np.ndarray, wide: np.dtype) -> np.ndarray:
+        from .compression import wire_compressor_for
+
         group = list(range(self.topo.size))
+        comp = wire_compressor_for(buf.dtype)
         bounds = _ring_reduce_scatter(
-            self.mesh, buf, group, self.topo.rank, wide, self.fusion_buffers)
+            self.mesh, buf, group, self.topo.rank, wide,
+            self.fusion_buffers, compressor=comp)
+        if comp is not None:
+            own = (self.topo.rank + 1) % len(group)
+            _quantize_owned(comp, buf[bounds[own]:bounds[own + 1]],
+                            self.fusion_buffers)
         _ring_allgather_chunks(
-            self.mesh, buf, group, self.topo.rank, bounds)
+            self.mesh, buf, group, self.topo.rank, bounds,
+            self.fusion_buffers, compressor=comp)
         return buf
 
 
@@ -373,7 +461,10 @@ class HierarchicalAllreduce(RingAllreduce):
                 + topo.local_rank)
 
     def _ring_allreduce(self, buf: np.ndarray, wide: np.dtype) -> np.ndarray:
+        from .compression import wire_compressor_for
+
         t = self.topo
+        comp = wire_compressor_for(buf.dtype)
         local_group = [t.cross_rank * t.local_size + l
                        for l in range(t.local_size)]
         cross_group = [c * t.local_size + t.local_rank
@@ -381,17 +472,29 @@ class HierarchicalAllreduce(RingAllreduce):
 
         bounds = _ring_reduce_scatter(
             self.mesh, buf, local_group, t.local_rank, wide,
-            self.fusion_buffers)
+            self.fusion_buffers, compressor=comp)
         own = (t.local_rank + 1) % t.local_size
         seg = buf[bounds[own]:bounds[own + 1]]
         if seg.size:
             seg_bounds = _ring_reduce_scatter(
                 self.mesh, seg, cross_group, t.cross_rank, wide,
-                self.fusion_buffers)
+                self.fusion_buffers, compressor=comp)
+            if comp is not None:
+                own_c = (t.cross_rank + 1) % t.cross_size
+                _quantize_owned(
+                    comp, seg[seg_bounds[own_c]:seg_bounds[own_c + 1]],
+                    self.fusion_buffers)
             _ring_allgather_chunks(
-                self.mesh, seg, cross_group, t.cross_rank, seg_bounds)
+                self.mesh, seg, cross_group, t.cross_rank, seg_bounds,
+                self.fusion_buffers, compressor=comp)
+        if comp is not None:
+            # The whole owned chunk goes into the local allgather; parts
+            # restored from the wire are already quantized (idempotent),
+            # this pins the cross-phase leftovers.
+            _quantize_owned(comp, seg, self.fusion_buffers)
         _ring_allgather_chunks(
-            self.mesh, buf, local_group, t.local_rank, bounds)
+            self.mesh, buf, local_group, t.local_rank, bounds,
+            self.fusion_buffers, compressor=comp)
         return buf
 
 

@@ -88,6 +88,33 @@ HOROVOD_FAULT_SPEC = "HOROVOD_FAULT_SPEC"
 # a FrameCorruptError + coordinated abort (docs/integrity.md).  All ranks
 # must agree — the launcher env propagates it like every other knob.
 HOROVOD_WIRE_CRC = "HOROVOD_WIRE_CRC"
+# Shadow (deferred) digesting for ring data frames ("1"/"0", default on,
+# effective only with HOROVOD_WIRE_CRC on): segment frames inside a ring
+# step carry NO inline CRC field — each endpoint chains per-segment
+# digests off the serial path and a small inline-CRC'd digest-check frame
+# closes the step (transport/tcp.py; docs/integrity.md).  "0" restores
+# the strict per-frame inline CRC.  All ranks must agree.
+HOROVOD_WIRE_CRC_SHADOW = "HOROVOD_WIRE_CRC_SHADOW"
+# Digest algorithm for the deferred (shadow) path: "fold64" (default —
+# vectorized 64-bit sum/xor fold, ~10x faster than crc32 on the CI box)
+# or "crc32" (chained zlib.crc32: the step chain equals the crc32 of the
+# concatenated payload stream).  Control frames and non-ring frames keep
+# inline crc32 regardless.  All ranks must agree.
+HOROVOD_WIRE_DIGEST = "HOROVOD_WIRE_DIGEST"
+# -- bandwidth plane (docs/data_plane.md) --
+# Cast-on-the-wire gradient compression for the host-ring allreduce:
+# "none" (default) | "fp16" | "bf16".  f32/f64 payloads are cast per
+# segment into a keyed staging arena at send and restored/reduced in wide
+# precision on land (backend/compression.py); other dtypes pass through
+# uncompressed.  Frame headers carry the wire dtype code, so ranks that
+# disagree on this knob fail loudly (poisoned stream), not silently.
+HOROVOD_WIRE_COMPRESSION = "HOROVOD_WIRE_COMPRESSION"
+# Coordinator fusion-bucket ordering: "readiness" (default — tensors are
+# packed in the order their negotiations were FIRST announced, so early
+# gradients fly while late layers still compute) or "arrival" (the
+# legacy completion order).  Applies to the full-ResponseList path only;
+# the mask fast path keeps its deterministic ascending-bit order.
+HOROVOD_FUSION_ORDER = "HOROVOD_FUSION_ORDER"
 # Elastic blacklist cooldown: a blacklisted host rejoins the candidate
 # pool after this many seconds (0 = permanent, the reference behavior).
 HOROVOD_BLACKLIST_COOLDOWN_SECS = "HOROVOD_BLACKLIST_COOLDOWN_SECS"

@@ -179,9 +179,20 @@ class Controller:
                 else "star"
         # A 2-rank tree degenerates to the star exactly.
         self.fanout_topology = "star" if topology.size <= 2 else topo_env
-        # FIFO completion order like the reference: responses are emitted in
-        # the order tensors *complete*, which is deterministic because only
-        # the coordinator decides it.
+        # Fusion ordering: "arrival" emits responses in the order tensors
+        # *complete* within the cycle (biased by the coordinator's rank scan
+        # order); "readiness" (default) sorts the cycle's completed set by
+        # each tensor's first_seen timestamp, so the tensors that have been
+        # negotiating longest — the ones downstream ranks are most likely
+        # already blocked on — pack into the front fusion buckets.  Only the
+        # coordinator sorts (it alone decides order, workers replay the
+        # ResponseList), so determinism is preserved.
+        order = env_mod.get_str(
+            env_mod.HOROVOD_FUSION_ORDER, "readiness").strip().lower()
+        if order not in ("readiness", "arrival"):
+            raise ValueError(
+                f"HOROVOD_FUSION_ORDER={order!r}: expected readiness|arrival")
+        self.fusion_order = order
 
     # ------------------------------------------------------------------
     # the per-cycle negotiation round
@@ -358,6 +369,21 @@ class Controller:
                 if len(entry.ranks) >= needed:
                     ready.append(name)
 
+        # Readiness-ordered fusion: sort this cycle's completions by how
+        # long each tensor has been negotiating (first_seen) before the
+        # table entries are popped below.  The stable sort keeps arrival
+        # order among ties; JOIN (never in the table) sorts first.  The
+        # mask fast path is untouched — its bit order is already mirrored
+        # deterministically on every rank.
+        if self.fusion_order == "readiness" and len(ready) > 1:
+            table = self._message_table
+            by_age = sorted(
+                ready,
+                key=lambda n: e.first_seen
+                if (e := table.get(n)) is not None else 0.0)
+            if by_age != ready:
+                metrics.inc("fusion_reorders_total")
+                ready = by_age
         responses = [self._construct_response(name) for name in ready]
         responses = [r for r in responses if r is not None]
         mask_responses, ready_mask, mask_pure = self._mask_round(pending)

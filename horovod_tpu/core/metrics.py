@@ -109,6 +109,13 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "crc_verify_seconds_total": (
         "counter", "seconds spent computing/verifying wire CRC32 "
                    "(ROADMAP item 2's direct measurement)"),
+    "crc_shadow_seconds_total": (
+        "counter", "seconds spent in deferred (shadow) wire digests — "
+                   "runs off the serial path, so this measures overlap "
+                   "cost, not added step latency"),
+    "wire_compress_seconds_total": (
+        "counter", "seconds spent casting payloads to/from the wire "
+                   "dtype (compress, widen-reduce, restore, quantize)"),
     "aborts_total": (
         "counter", "coordinated aborts, labeled dir=sent|received"),
     "faults_injected_total": (
@@ -125,6 +132,14 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "wire_heap_copies_total": (
         "counter", "payload materializations in the host data plane "
                    "(wire_stats view; the zero-copy guard's counter)"),
+    "wire_compressed_bytes_total": (
+        "counter", "narrow payload bytes produced/consumed by wire "
+                   "compression (wire_stats view; compare against "
+                   "wire_bytes_on_wire_total for the achieved ratio)"),
+    # -- bandwidth plane --
+    "fusion_reorders_total": (
+        "counter", "negotiation cycles where readiness ordering changed "
+                   "the fusion packing order (coordinator only)"),
     # -- raw stat names (the literals fed to phase_stats/wire_stats.add;
     #    HVD007 checks those call sites against this catalog too) --
     "negotiate": ("stat", "phase_stats: controller round, busy cycles"),
@@ -134,6 +149,7 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "wait": ("stat", "phase_stats: framework-thread handle waits"),
     "bytes_on_wire": ("stat", "wire_stats: per-frame payload bytes"),
     "heap_copies": ("stat", "wire_stats: data-plane materializations"),
+    "compressed_bytes": ("stat", "wire_stats: narrow wire-dtype bytes"),
 }
 
 #: Fast-path flag (the ``faults.ACTIVE`` pattern): when False every

@@ -7,13 +7,26 @@ run over those sockets.  We are MPI- and gloo-free by design (north star), so
 this module is that fabric: a framed, thread-safe, full-mesh TCP transport
 bootstrapped through a ``Store``.
 
-Framing: ``<Q len|flags><I crc32(payload)>`` + payload — an 8-byte
-little-endian length word whose top bit marks control frames
-(``_CTRL_FLAG``), followed by a 4-byte CRC32 of the payload when
-``HOROVOD_WIRE_CRC`` is on (the default; the CRC field is absent entirely
-when it is off), then the payload bytes.  Connection establishment is
-deterministic to avoid crossed sockets: every rank listens; rank *i* dials
-every rank *j < i* and introduces itself with an 8-byte hello (magic + rank).
+Framing: ``<Q len|flags>[<I crc32(payload)>]`` + payload — an 8-byte
+little-endian length word whose top bits carry the frame flags, followed
+by a 4-byte CRC32 of the payload when ``HOROVOD_WIRE_CRC`` is on (the
+default) and the frame is not digest-deferred, then the payload bytes.
+Flag bits: bit 63 marks control frames (``_CTRL_FLAG``); bit 62 marks a
+digest-DEFERRED data frame (``_DEFER_FLAG``) — no inline CRC field
+follows, the frame is covered instead by the ring step's chained shadow
+digest (``transport/digest.py``), closed out by a digest-check frame; bit
+61 marks that digest-check frame itself (``_DIGEST_FLAG``, always
+inline-CRC'd — it IS the verification); bits 56-58 carry the wire dtype
+code (``_WIRE_DTYPE_MASK``) stamped by cast-on-the-wire compression
+(``backend/compression.py``), so peers that disagree on
+``HOROVOD_WIRE_COMPRESSION`` poison the stream loudly instead of
+mis-decoding bytes.  A pre-flags peer masks only bit 63, reads any
+flagged frame as an absurd length, and aborts on the frame-size cap —
+mixed-version meshes fail loudly by construction.  When
+``HOROVOD_WIRE_CRC`` is off the CRC field is absent from every frame.
+Connection establishment is deterministic to avoid crossed sockets: every
+rank listens; rank *i* dials every rank *j < i* and introduces itself
+with an 8-byte hello (magic + rank).
 
 Zero-copy data plane: ``send`` accepts any C-contiguous bytes-like object
 (a memoryview over a numpy slice included) and writes ``[header, payload]``
@@ -29,6 +42,7 @@ notification path can interleave safely.
 
 from __future__ import annotations
 
+import collections
 import queue
 import select
 import socket
@@ -38,6 +52,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from . import digest as digest_mod
 from ..common import faults
 from ..common.exceptions import (
     CoordinatedAbortError,
@@ -66,6 +81,29 @@ _CRC = struct.Struct("<I")
 # same socket while staying unambiguous against arbitrary payload bytes —
 # no payload is ever 2^63 bytes long.
 _CTRL_FLAG = 1 << 63
+# Digest-DEFERRED data frame: no inline <I> CRC field follows the length
+# word — the payload is covered by the ring step's chained shadow digest
+# instead (module docstring; docs/integrity.md).
+_DEFER_FLAG = 1 << 62
+# Digest-CHECK frame closing a deferred ring step (<B algo><Q digest>
+# <Q frames> payload, always inline-CRC'd when the mesh CRC is on).
+_DIGEST_FLAG = 1 << 61
+# Wire dtype code (3 bits) stamped by cast-on-the-wire compression:
+# 0 = raw/uncompressed; nonzero codes are allocated by
+# backend/compression.py.  Carried per frame so compression-config skew
+# between peers is a loud poisoned-stream abort, not silent garbage.
+_WIRE_DTYPE_SHIFT = 56
+_WIRE_DTYPE_MASK = 0x7 << _WIRE_DTYPE_SHIFT
+# All header flag bits — everything that is not payload length.
+_FLAGS_MASK = _CTRL_FLAG | _DEFER_FLAG | _DIGEST_FLAG | _WIRE_DTYPE_MASK
+# Digest-check frame payload: digest algorithm code, 64-bit chained
+# digest, frame count for the step it closes.
+_DIGEST_PAYLOAD = struct.Struct("<BQQ")
+
+#: Decoded frame header: ``crc`` is None when the mesh CRC is off or the
+#: frame is digest-deferred.
+_FrameHeader = collections.namedtuple(
+    "_FrameHeader", ("ctrl", "deferred", "check", "wire_dtype", "size", "crc"))
 # How often a blocked recv wakes to check the mesh-wide abort flag and its
 # progress deadline.  Bounds abort-propagation latency for threads blocked
 # on a DIFFERENT peer's socket than the one the abort arrived on.
@@ -209,6 +247,16 @@ class TcpMesh:
         # frame header, receiver verifies before handing bytes up.  All
         # ranks must agree (env-propagated like every other knob).
         self.wire_crc = env_mod.get_bool(env_mod.HOROVOD_WIRE_CRC, True)
+        # Shadow (deferred) digesting for ring data frames (default on,
+        # effective only with the CRC on): segment frames skip the inline
+        # CRC field; each endpoint chains per-frame digests off the
+        # serial path and a digest-check frame closes the step.  "0"
+        # restores strict per-frame inline CRC.  All ranks must agree.
+        self.crc_shadow = env_mod.get_bool(
+            env_mod.HOROVOD_WIRE_CRC_SHADOW, True)
+        self.digest_algo = digest_mod.algo_from_name(
+            env_mod.get_str(env_mod.HOROVOD_WIRE_DIGEST, "fold64")
+            or "fold64")
         # Mesh-wide abort state: (epoch, origin_rank, reason) once any link
         # delivered (or this rank broadcast) a coordinated abort.  Blocked
         # recvs observe it within _ABORT_POLL_SECS regardless of which
@@ -497,6 +545,30 @@ class TcpMesh:
         metrics.inc("crc_verify_seconds_total", time.perf_counter() - t0)
         return crc
 
+    @property
+    def deferred_digests(self) -> bool:
+        """True when ring steps should use the shadow-digest path
+        (``HOROVOD_WIRE_CRC`` on and ``HOROVOD_WIRE_CRC_SHADOW`` not
+        disabled)."""
+        return self.wire_crc and self.crc_shadow
+
+    def new_digest(self) -> digest_mod.StreamDigest:
+        """Fresh chained digest for one direction of one ring step."""
+        return digest_mod.StreamDigest(self.digest_algo)
+
+    @staticmethod
+    def _digest_timed(dig: digest_mod.StreamDigest, view) -> None:
+        """``StreamDigest.update`` with its cost accounted to
+        ``crc_shadow_seconds_total`` — the shadow path's counterpart of
+        ``_crc32_timed``, so the deferred-digest cost stays measurable on
+        live jobs next to the inline CRC's counter."""
+        if not metrics.ENABLED:
+            dig.update(view)
+            return
+        t0 = time.perf_counter()
+        dig.update(view)
+        metrics.inc("crc_shadow_seconds_total", time.perf_counter() - t0)
+
     def _check_alive(self, p: _Peer, peer: int) -> None:
         if self._abort is not None:
             raise CoordinatedAbortError(*self._abort)
@@ -507,12 +579,24 @@ class TcpMesh:
         if p.dead is None:
             p.dead = reason
 
-    def send(self, peer: int, payload) -> None:
+    def send(self, peer: int, payload,
+             digest: Optional[digest_mod.StreamDigest] = None,
+             wire_dtype: int = 0, _check_frame: bool = False) -> None:
         """Frame and send one payload — any C-contiguous bytes-like object
         (memoryview over a numpy slice included), never copied: the frame
         header and the payload view go to the kernel as one vectored
-        write."""
+        write.
+
+        With ``digest`` (and the mesh CRC on), the frame goes out
+        digest-DEFERRED: no inline CRC field — the payload is folded into
+        ``digest`` right after the vectored write is handed to the
+        kernel (the shadow slot: the fold runs while the bytes are on the
+        wire), and the caller closes the step with
+        :meth:`send_step_digest`.  ``wire_dtype`` stamps the compression
+        dtype code into the header so peers that disagree on
+        ``HOROVOD_WIRE_COMPRESSION`` fail loudly on receipt."""
         p = self._peer(peer)
+        deferred = digest is not None and self.wire_crc
         with p.send_lock:
             self._check_alive(p, peer)
             try:
@@ -533,11 +617,26 @@ class TcpMesh:
                         # layer.
                         payload = _as_byte_view(verdict.payload)
                         wire = _as_byte_view(verdict.wire_bytes())
-                header = _LEN.pack(len(payload))
-                if self.wire_crc:
+                flags = (wire_dtype << _WIRE_DTYPE_SHIFT) & _WIRE_DTYPE_MASK
+                if deferred:
+                    flags |= _DEFER_FLAG
+                if _check_frame:
+                    flags |= _DIGEST_FLAG
+                header = _LEN.pack(len(payload) | flags)
+                if self.wire_crc and not deferred:
                     header += _CRC.pack(self._crc32_timed(payload))
                 self._send_bounded(p, [memoryview(header), wire])
-                wire_stats.add("bytes_on_wire", len(payload))
+                if deferred:
+                    # Digest the LOGICAL payload, not the wire bytes: an
+                    # injected corrupt flip mutates only the latter —
+                    # exactly the disagreement the peer's chain must
+                    # catch at the digest-check frame.
+                    self._digest_timed(digest, payload)
+                if not _check_frame:
+                    # Digest-check frames are integrity metadata, not
+                    # data payload — excluded like control frames so the
+                    # zero-copy tests' exact byte accounting holds.
+                    wire_stats.add("bytes_on_wire", len(payload))
                 flight_recorder.record("frame", dir="send", peer=peer,
                                        nbytes=len(payload))
             except _ProgressStall as e:
@@ -604,22 +703,31 @@ class TcpMesh:
                 if faults.ACTIVE:
                     faults.inject("tcp.recv", rank=self.rank, peer=peer)
                 while True:
-                    ctrl, size, crc = self._recv_header(p, peer)
-                    if ctrl:
-                        self._consume_control_frame(p, peer, size, crc)
+                    hdr = self._recv_header(p, peer)
+                    if hdr.ctrl:
+                        self._consume_control_frame(p, peer, hdr.size,
+                                                    hdr.crc)
                         continue  # stale control frame: keep reading
-                    payload = self._recv_bounded(p, size)
+                    if hdr.deferred or hdr.check or hdr.wire_dtype:
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"flagged data frame from rank {peer} on the "
+                            f"control recv path (deferred={hdr.deferred}, "
+                            f"check={hdr.check}, "
+                            f"wire_dtype={hdr.wire_dtype}): wire-CRC/"
+                            "compression framing skew between peers; "
+                            "aborting, resync is impossible by design"))
+                    payload = self._recv_bounded(p, hdr.size)
                     p.frames_in += 1
-                    if crc is not None:
+                    if hdr.crc is not None:
                         got = self._crc32_timed(payload)
-                        if got != crc:
+                        if got != hdr.crc:
                             self._poison_stream(
                                 p, peer,
                                 FrameCorruptError(peer, p.frames_in,
-                                                  crc, got))
-                    wire_stats.add("bytes_on_wire", size)
+                                                  hdr.crc, got))
+                    wire_stats.add("bytes_on_wire", hdr.size)
                     flight_recorder.record("frame", dir="recv", peer=peer,
-                                           nbytes=size)
+                                           nbytes=hdr.size)
                     return payload
             except _ProgressStall as e:
                 self._mark_dead(p, str(e))
@@ -629,7 +737,9 @@ class TcpMesh:
                 raise PeerGoneError(
                     peer, f"recv from rank {peer} failed: {e}") from e
 
-    def recv_into(self, peer: int, dest) -> int:
+    def recv_into(self, peer: int, dest,
+                  digest: Optional[digest_mod.StreamDigest] = None,
+                  wire_dtype: int = 0) -> int:
         """Receive one data frame's payload directly into ``dest`` (a
         writable C-contiguous bytes-like — typically a memoryview over a
         numpy staging slice); returns the payload size.
@@ -644,6 +754,15 @@ class TcpMesh:
         stream like a CRC failure — reading on after a misframe would
         turn one bad frame into positional desync.
 
+        With ``digest``, the frame is expected digest-DEFERRED (no inline
+        CRC field): the landed payload is folded into ``digest`` — on the
+        helper thread when posted via :meth:`recv_into_async`, i.e. in
+        the shadow of the main thread's reduction — and the caller
+        settles integrity with :meth:`verify_step_digest`.  ``wire_dtype``
+        is the compression dtype code this rank expects; any header
+        disagreement (deferred-ness or dtype code) poisons the stream —
+        config/version skew must fail loudly, not decode garbage.
+
         Control frames (coordinated abort) interleave transparently, as
         on the :meth:`recv` path."""
         p = self._peer(peer)
@@ -654,28 +773,56 @@ class TcpMesh:
                 if faults.ACTIVE:
                     faults.inject("tcp.recv", rank=self.rank, peer=peer)
                 while True:
-                    ctrl, size, crc = self._recv_header(p, peer)
-                    if ctrl:
-                        self._consume_control_frame(p, peer, size, crc)
+                    hdr = self._recv_header(p, peer)
+                    if hdr.ctrl:
+                        self._consume_control_frame(p, peer, hdr.size,
+                                                    hdr.crc)
                         continue  # stale control frame: keep reading
-                    if size != len(dv):
+                    if hdr.check:
                         self._poison_stream(p, peer, HorovodInternalError(
-                            f"data frame from rank {peer} carries {size} "
-                            f"bytes but the recv_into destination expects "
+                            f"unexpected digest-check frame from rank "
+                            f"{peer} where a data frame was due: ring-step "
+                            "framing skew between peers; aborting"))
+                    if hdr.deferred != (digest is not None):
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"data frame from rank {peer} is "
+                            f"{'digest-deferred' if hdr.deferred else 'inline-CRC'} "
+                            f"but this rank expected the "
+                            f"{'deferred' if digest is not None else 'inline'} "
+                            "wire-CRC path: HOROVOD_WIRE_CRC_SHADOW skew "
+                            "between peers; aborting loudly"))
+                    if hdr.wire_dtype != wire_dtype:
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"data frame from rank {peer} carries wire "
+                            f"dtype code {hdr.wire_dtype} but this rank "
+                            f"expects {wire_dtype}: "
+                            "HOROVOD_WIRE_COMPRESSION skew between peers "
+                            "(mixed-version or mixed-config mesh); "
+                            "aborting loudly instead of mis-decoding"))
+                    if hdr.size != len(dv):
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"data frame from rank {peer} carries "
+                            f"{hdr.size} bytes but the recv_into "
+                            f"destination expects "
                             f"{len(dv)}: misframed stream (truncated or "
                             "desynced); aborting, resync is impossible by "
                             "design"))
                     got = self._recv_bounded_into(
-                        p, dv, with_crc=crc is not None)
+                        p, dv, with_crc=hdr.crc is not None)
                     p.frames_in += 1
-                    if crc is not None and got != crc:
+                    if hdr.crc is not None and got != hdr.crc:
                         self._poison_stream(
                             p, peer,
-                            FrameCorruptError(peer, p.frames_in, crc, got))
-                    wire_stats.add("bytes_on_wire", size)
+                            FrameCorruptError(peer, p.frames_in, hdr.crc,
+                                              got))
+                    if digest is not None:
+                        # Shadow slot: the complete landed frame is
+                        # folded here, off the main thread's serial path.
+                        self._digest_timed(digest, dv)
+                    wire_stats.add("bytes_on_wire", hdr.size)
                     flight_recorder.record("frame", dir="recv", peer=peer,
-                                           nbytes=size)
-                    return size
+                                           nbytes=hdr.size)
+                    return hdr.size
             except _ProgressStall as e:
                 self._mark_dead(p, str(e))
                 raise PeerGoneError(peer, str(e)) from None
@@ -702,19 +849,104 @@ class TcpMesh:
                     FrameCorruptError(peer, p.frames_in, crc, got))
         self._handle_control(payload, peer)
 
-    def _recv_header(self, p: _Peer, peer: int):
-        """Read one frame header: ``(is_control, payload_size, crc|None)``."""
+    def _recv_header(self, p: _Peer, peer: int) -> _FrameHeader:
+        """Read and decode one frame header (flag bits per the module
+        docstring).  The inline CRC field is present only when the mesh
+        CRC is on AND the frame is not digest-deferred."""
         n = _LEN.unpack(self._recv_bounded(p, _LEN.size))[0]
-        size = n & ~_CTRL_FLAG
+        size = n & ~_FLAGS_MASK
         if size > _MAX_FRAME_BYTES:
             self._poison_stream(p, peer, HorovodInternalError(
                 f"frame header from rank {peer} claims "
                 f"{size} bytes (cap {_MAX_FRAME_BYTES}): "
                 "corrupted length word; aborting before "
                 "allocating it"))
+        deferred = bool(n & _DEFER_FLAG)
         crc = _CRC.unpack(self._recv_bounded(p, _CRC.size))[0] \
-            if self.wire_crc else None
-        return bool(n & _CTRL_FLAG), size, crc
+            if self.wire_crc and not deferred else None
+        return _FrameHeader(bool(n & _CTRL_FLAG), deferred,
+                            bool(n & _DIGEST_FLAG),
+                            (n & _WIRE_DTYPE_MASK) >> _WIRE_DTYPE_SHIFT,
+                            size, crc)
+
+    def send_step_digest(self, peer: int, dig: digest_mod.StreamDigest,
+                         frames: int) -> None:
+        """Close one deferred ring-step direction: emit the digest-check
+        frame carrying (algo, chained digest, frame count), itself
+        inline-CRC'd — the check frame IS the integrity settlement, so it
+        never defers."""
+        self.send(peer,
+                  _DIGEST_PAYLOAD.pack(dig.algo, dig.value(), frames),
+                  _check_frame=True)
+
+    def verify_step_digest(self, peer: int, dig: digest_mod.StreamDigest,
+                           frames: int) -> None:
+        """Read the peer's digest-check frame and compare it against the
+        locally chained ``dig``; any disagreement — digest value, frame
+        count, or algorithm — poisons the stream exactly like an inline
+        CRC mismatch (corrupted data never escapes the collective that
+        received it).  Must run strictly after every recv of the step
+        completed (the ring waits each ``PendingRecv``), so the helper
+        thread is quiescent for this peer and the check frame is next in
+        FIFO order."""
+        p = self._peer(peer)
+        with p.recv_lock:
+            self._check_alive(p, peer)
+            try:
+                while True:
+                    hdr = self._recv_header(p, peer)
+                    if hdr.ctrl:
+                        self._consume_control_frame(p, peer, hdr.size,
+                                                    hdr.crc)
+                        continue  # stale control frame: keep reading
+                    if not hdr.check:
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"expected a digest-check frame from rank "
+                            f"{peer} to close the ring step but got a "
+                            "data frame: step framing skew between "
+                            "peers; aborting"))
+                    if hdr.size != _DIGEST_PAYLOAD.size:
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"digest-check frame from rank {peer} "
+                            f"carries {hdr.size} bytes (expected "
+                            f"{_DIGEST_PAYLOAD.size}): misframed stream "
+                            "(truncated or desynced); aborting"))
+                    payload = self._recv_bounded(p, hdr.size)
+                    p.frames_in += 1
+                    if hdr.crc is not None:
+                        got = self._crc32_timed(payload)
+                        if got != hdr.crc:
+                            self._poison_stream(
+                                p, peer,
+                                FrameCorruptError(peer, p.frames_in,
+                                                  hdr.crc, got))
+                    algo, value, count = _DIGEST_PAYLOAD.unpack(payload)
+                    if algo != dig.algo:
+                        self._poison_stream(p, peer, HorovodInternalError(
+                            f"digest-check frame from rank {peer} uses "
+                            f"wire digest "
+                            f"{digest_mod.algo_name(algo)!r} but this "
+                            f"rank runs "
+                            f"{digest_mod.algo_name(dig.algo)!r}: "
+                            "HOROVOD_WIRE_DIGEST skew between peers"))
+                    if count != frames or value != dig.value():
+                        # Same failure plane as an inline CRC mismatch:
+                        # some frame in the step (or the step framing
+                        # itself) went bad and resync is impossible.
+                        self._poison_stream(
+                            p, peer,
+                            FrameCorruptError(peer, p.frames_in, value,
+                                              dig.value()))
+                    flight_recorder.record("frame", dir="recv", peer=peer,
+                                           nbytes=hdr.size)
+                    return
+            except _ProgressStall as e:
+                self._mark_dead(p, str(e))
+                raise PeerGoneError(peer, str(e)) from None
+            except OSError as e:
+                self._mark_dead(p, f"recv from rank {peer} failed: {e}")
+                raise PeerGoneError(
+                    peer, f"recv from rank {peer} failed: {e}") from e
 
     def _recv_bounded(self, p: _Peer, n: int) -> bytes:
         buf = bytearray(n)
@@ -891,7 +1123,9 @@ class TcpMesh:
             raise box[1]
         return box[0]
 
-    def recv_into_async(self, peer: int, dest) -> PendingRecv:
+    def recv_into_async(self, peer: int, dest,
+                        digest: Optional[digest_mod.StreamDigest] = None,
+                        wire_dtype: int = 0) -> PendingRecv:
         """Post a :meth:`recv_into` on the persistent helper thread and
         return a :class:`PendingRecv` handle — the segment-pipeline
         primitive: the collective layer posts the recv for segment k+1,
@@ -900,13 +1134,15 @@ class TcpMesh:
 
         Posts are FIFO on one helper thread, so posting recvs for
         segments k and k+1 back-to-back maps them onto the peer's frames
-        in wire order."""
+        in wire order — which also serializes ``digest`` updates in frame
+        order without any extra locking."""
         done = threading.Event()
         box: List = [None, None]  # [nbytes, error]
 
         def _recv():
             try:
-                box[0] = self.recv_into(peer, dest)
+                box[0] = self.recv_into(peer, dest, digest=digest,
+                                        wire_dtype=wire_dtype)
             except BaseException as e:  # noqa: BLE001
                 box[1] = e
             finally:

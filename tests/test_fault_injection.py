@@ -319,6 +319,38 @@ def test_corrupt_abort_writes_flight_recorder_dump_on_every_rank(tmp_path):
 
 
 @pytest.mark.timeout(150)
+def test_corrupt_compressed_frame_np2_coordinated_abort():
+    """Compression must not open an integrity hole: a byte flip on a
+    COMPRESSED (fp16-on-the-wire, digest-deferred) frame is caught by the
+    step digest and aborts both ranks with the wire-CRC diagnosis."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_WIRE_COMPRESSION": "fp16",
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=6:action=corrupt,1"})
+    assert "SURVIVOR_ABORT 0" in outs[0], outs[0]
+    assert "wire CRC" in outs[0], outs[0]
+    assert "SURVIVOR_ABORT 1" in outs[1], outs[1]
+
+
+@pytest.mark.timeout(150)
+def test_truncate_compressed_frame_np2_coordinated_abort():
+    """A truncated compressed frame misframes the stream; the size/parse
+    layer (or the step digest, whichever meets it first) must convert it
+    into a coordinated abort — never a hang or a struct.error."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_WIRE_COMPRESSION": "fp16",
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=6:action=truncate,4"})
+    for r in range(2):
+        assert f"SURVIVOR_ABORT {r}" in outs[r], (r, outs[r])
+        assert "struct.error" not in outs[r], (r, outs[r])
+
+
+@pytest.mark.timeout(150)
 def test_truncated_frame_np2_typed_abort():
     """A misframed (short) application frame passes the wire CRC by
     construction and must be caught by the defensive parse layer as a
@@ -440,7 +472,7 @@ hvd.shutdown()
 """
 
 
-def _run_elastic_corruption_job(tmp_path, fault_spec):
+def _run_elastic_corruption_job(tmp_path, fault_spec, extra_env=None):
     disc = tmp_path / "discover.sh"
     disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
     disc.chmod(0o755)
@@ -449,6 +481,7 @@ def _run_elastic_corruption_job(tmp_path, fault_spec):
 
     env = os.environ.copy()
     env.update(_FAST_DEADLINE)
+    env.update(extra_env or {})
     env["HOROVOD_LOG_LEVEL"] = "info"  # driver logs the reset trigger
     env.pop("HOROVOD_FAULT_SPEC", None)
     if fault_spec:
@@ -483,6 +516,23 @@ def test_elastic_recovers_from_frame_corruption_bit_identical(tmp_path):
     # driver logged the worker's reset request naming the CRC failure
     assert "reset_requests" in proc.stderr and "advancing epoch" \
         in proc.stderr, proc.stderr[-3000:]
+    assert "wire CRC" in proc.stderr, proc.stderr[-3000:]
+
+
+@pytest.mark.timeout(600)
+def test_elastic_recovers_from_corruption_with_compression_on(tmp_path):
+    """The full composition: fp16 wire compression + shadow digests +
+    an in-flight byte flip.  The step digest catches the flip, both ranks
+    roll back and re-rendezvous, and the finished params are BIT-identical
+    to a no-fault run with the same compression config (quantization is
+    deterministic, so recovery replay converges exactly)."""
+    comp_env = {"HOROVOD_WIRE_COMPRESSION": "fp16"}
+    clean, _ = _run_elastic_corruption_job(tmp_path, None,
+                                           extra_env=comp_env)
+    faulted, proc = _run_elastic_corruption_job(
+        tmp_path, "tcp.send:rank=1:nth=25:action=corrupt,1",
+        extra_env=comp_env)
+    assert faulted == clean, "recovery did not converge to the no-fault run"
     assert "wire CRC" in proc.stderr, proc.stderr[-3000:]
 
 

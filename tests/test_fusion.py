@@ -174,3 +174,74 @@ def test_xla_fused_allgather_single_dispatch():
                        np.arange(6).reshape(3, 2))
     assert np.allclose(np.asarray(entries[1].output),
                        np.arange(4).reshape(4, 1))
+
+
+# ---------------------------------------------------------------------------
+# readiness-ordered fusion (HOROVOD_FUSION_ORDER)
+# ---------------------------------------------------------------------------
+
+
+def _coordinator_np2(monkeypatch, order):
+    from horovod_tpu.common import env as env_mod
+
+    monkeypatch.setenv(env_mod.HOROVOD_FUSION_ORDER, order)
+    topo = ProcessTopology(rank=0, size=2, local_rank=0, local_size=2,
+                           cross_rank=0, cross_size=1)
+    # mesh=None is fine: _gather_request_lists is patched per cycle; the
+    # cache fast path is off so no compact frames are broadcast either.
+    return Controller(topo, None, cache_capacity=0)
+
+
+def _drive_two_cycles(monkeypatch, c):
+    """Cycle 1: rank 0 announces "late_first" (incomplete — rank 1 silent).
+    Cycle 2: rank 0 announces "early_second"; rank 1 announces BOTH, with
+    "early_second" first — so arrival (completion-scan) order within cycle
+    2 is [early_second, late_first], while readiness (first_seen) order is
+    [late_first, early_second]."""
+    from horovod_tpu.core.messages import Request, RequestList
+
+    def req(name, rank):
+        return Request(request_rank=rank, tensor_name=name,
+                       tensor_shape=[8])
+
+    monkeypatch.setattr(c, "_gather_request_lists",
+                        lambda: iter([(1, RequestList(), False)]))
+    monkeypatch.setattr(c, "_broadcast_response_payload",
+                        lambda payload: None)
+    rl1 = c._coordinator_round([req("late_first", 0)], False)
+    assert not rl1.responses  # still waiting on rank 1
+
+    monkeypatch.setattr(
+        c, "_gather_request_lists",
+        lambda: iter([(1, RequestList(requests=[
+            req("early_second", 1), req("late_first", 1)]), False)]))
+    rl2 = c._coordinator_round([req("early_second", 0)], False)
+    return [n for r in rl2.responses for n in r.tensor_names]
+
+
+def test_readiness_order_puts_oldest_negotiation_first(monkeypatch):
+    from horovod_tpu.core import metrics
+
+    c = _coordinator_np2(monkeypatch, "readiness")
+    before = metrics.registry.get_counter("fusion_reorders_total")
+    names = _drive_two_cycles(monkeypatch, c)
+    assert names == ["late_first", "early_second"], names
+    after = metrics.registry.get_counter("fusion_reorders_total")
+    assert after == before + 1
+
+
+def test_arrival_order_keeps_completion_scan_order(monkeypatch):
+    c = _coordinator_np2(monkeypatch, "arrival")
+    names = _drive_two_cycles(monkeypatch, c)
+    assert names == ["early_second", "late_first"], names
+
+
+def test_fusion_order_knob_validates(monkeypatch):
+    from horovod_tpu.common import env as env_mod
+    import pytest as _pytest
+
+    monkeypatch.setenv(env_mod.HOROVOD_FUSION_ORDER, "fifo")
+    topo = ProcessTopology(rank=0, size=1, local_rank=0, local_size=1,
+                           cross_rank=0, cross_size=1)
+    with _pytest.raises(ValueError, match="HOROVOD_FUSION_ORDER"):
+        Controller(topo, None)

@@ -1,0 +1,292 @@
+"""Cast-on-the-wire compression: bit-exactness across ranks, the
+halved-bytes counter contract, and the zero-copy guard with compression
+on.
+
+Cross-rank bit-identity is the hard requirement (elastic recovery
+snapshots compare rank outputs bit for bit): after reduce-scatter each
+owner quantizes its own chunk through the wire dtype before allgather,
+so no rank keeps wide precision the others never saw.  Payloads are
+integer-valued and small so fp16/bf16 represent every partial sum
+exactly — making ``np.sum`` in float64 a legal bit-for-bit reference
+(and keeping fp16 off its pathological overflow-cast path).
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.backend import cpu_ring
+from horovod_tpu.backend import compression as comp_mod
+from horovod_tpu.backend.compression import (WIRE_DTYPE_BF16,
+                                             WIRE_DTYPE_FP16,
+                                             wire_compressor_for)
+from horovod_tpu.common import env as env_mod
+from horovod_tpu.core.timeline import wire_stats
+from horovod_tpu.transport import MemoryStore, TcpMesh
+
+from .test_transport import run_ranks
+
+pytestmark = pytest.mark.smoke
+
+_HAS_BF16 = True
+try:
+    import ml_dtypes  # noqa: F401
+except ImportError:  # pragma: no cover
+    _HAS_BF16 = False
+
+_MODES = ["fp16"] + (["bf16"] if _HAS_BF16 else [])
+
+
+def _int_valued(n, rank, dtype):
+    return ((np.arange(n) + rank) % 5 + rank + 1).astype(dtype)
+
+
+def _expected_sum(inputs, dtype):
+    acc = np.zeros(inputs[0].shape, np.float64)
+    for x in inputs:
+        acc += np.asarray(x, np.float64)
+    return acc.astype(dtype)
+
+
+def _compressed_allreduce(arrays, fbms=None, timeout=60):
+    """Drive the exact RingAllreduce._ring_allreduce sequence — RS with
+    compression, owner-chunk quantization, AG with compression — as
+    thread ranks over an in-process mesh."""
+    size = len(arrays)
+    store = MemoryStore()
+
+    def fn(rank):
+        mesh = TcpMesh(rank, size, store, bind_addr="127.0.0.1",
+                       advertise_addr="127.0.0.1", timeout=15)
+        try:
+            buf = arrays[rank]
+            wide = cpu_ring._accum_dtype(buf.dtype)
+            comp = wire_compressor_for(buf.dtype)
+            fbm = fbms[rank] if fbms is not None else None
+            group = list(range(size))
+            bounds = cpu_ring._ring_reduce_scatter(
+                mesh, buf, group, rank, wide, fbm, compressor=comp)
+            if comp is not None:
+                own = (rank + 1) % size
+                cpu_ring._quantize_owned(
+                    comp, buf[bounds[own]:bounds[own + 1]], fbm)
+            cpu_ring._ring_allgather_chunks(
+                mesh, buf, group, rank, bounds, fbm, compressor=comp)
+        finally:
+            mesh.close()
+
+    run_ranks(size, fn, timeout=timeout)
+    return arrays
+
+
+# ---------------------------------------------------------------------------
+# compressor unit behavior
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", _MODES)
+@pytest.mark.parametrize("work", [np.float32, np.float64],
+                         ids=lambda d: np.dtype(d).name)
+def test_compress_decompress_round_trip(monkeypatch, mode, work):
+    """Integer-valued payloads survive wide→narrow→wide exactly, for
+    both decompress flavors (reduce-add and allgather-restore)."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, mode)
+    c = wire_compressor_for(np.dtype(work))
+    assert c is not None and c.name == mode
+    src = _int_valued(257, 1, work)
+    arena = np.empty(512, c.wire_dtype)
+    narrow = c.compress(src, arena)
+    assert narrow.dtype == c.wire_dtype and narrow.size == src.size
+
+    out = np.zeros_like(src)
+    c.decompress_add(narrow, out)
+    assert np.array_equal(out, src)
+    c.decompress_add(narrow, out)  # reduce semantics: accumulates
+    assert np.array_equal(out, src * 2)
+
+    restored = np.empty_like(src)
+    c.decompress_into(narrow, restored)
+    assert np.array_equal(restored, src)
+
+
+@pytest.mark.parametrize("mode", _MODES)
+def test_quantize_inplace_is_idempotent(monkeypatch, mode):
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, mode)
+    c = wire_compressor_for(np.dtype(np.float32))
+    chunk = (np.arange(100, dtype=np.float32) / 7.0) + 0.1
+    arena = np.empty(128, c.wire_dtype)
+    c.quantize_inplace(chunk, arena)
+    once = chunk.copy()
+    c.quantize_inplace(chunk, arena)
+    assert np.array_equal(chunk, once), "quantize must be idempotent"
+
+
+def test_fp16_saturates_not_raises(monkeypatch):
+    """fp16's documented contract: out-of-range f32 saturates to inf
+    without warnings — loud failure is the job of NaN/inf checks upstream,
+    not a per-segment RuntimeWarning storm."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, "fp16")
+    c = wire_compressor_for(np.dtype(np.float32))
+    src = np.array([1.0, 1e38, -1e38], np.float32)
+    arena = np.empty(4, c.wire_dtype)
+    narrow = c.compress(src, arena)
+    assert np.isinf(narrow[1]) and np.isinf(narrow[2])
+
+
+def test_raw_dtypes_and_off_knob_pass_through(monkeypatch):
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, "fp16")
+    for dt in (np.int32, np.int64, np.float16):
+        assert wire_compressor_for(np.dtype(dt)) is None
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, "none")
+    assert wire_compressor_for(np.dtype(np.float32)) is None
+    monkeypatch.delenv(env_mod.HOROVOD_WIRE_COMPRESSION)
+    assert wire_compressor_for(np.dtype(np.float32)) is None
+
+
+def test_unknown_compression_name_raises(monkeypatch):
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, "zstd")
+    with pytest.raises(HorovodInternalError, match="HOROVOD_WIRE_COMPRESSION"):
+        wire_compressor_for(np.dtype(np.float32))
+
+
+def test_wire_dtype_codes_are_frame_header_stable():
+    """The codes ride in frame headers — renumbering them is a wire
+    protocol break, so they are pinned here."""
+    assert comp_mod.WIRE_DTYPE_RAW == 0
+    assert WIRE_DTYPE_FP16 == 1
+    assert WIRE_DTYPE_BF16 == 2
+
+
+# ---------------------------------------------------------------------------
+# ring allreduce end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", _MODES)
+@pytest.mark.parametrize("work", [np.float32, np.float64],
+                         ids=lambda d: np.dtype(d).name)
+@pytest.mark.parametrize("n", [1, 7, 1023])
+def test_compressed_ring_allreduce_bit_exact(monkeypatch, mode, work, n):
+    """np=3 compressed ring allreduce == the wide-precision reference,
+    bit for bit on EVERY rank, for odd counts that divide evenly by
+    neither the world size nor the segment size."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, mode)
+    size = 3
+    dtype = np.dtype(work)
+    inputs = [_int_valued(n, r, dtype) for r in range(size)]
+    expected = _expected_sum(inputs, dtype)
+    outs = _compressed_allreduce([x.copy() for x in inputs])
+    for r in range(size):
+        assert np.array_equal(outs[r], expected), r
+    for r in range(1, size):
+        assert outs[r].tobytes() == outs[0].tobytes(), \
+            f"rank {r} bit-diverged from rank 0"
+
+
+@pytest.mark.parametrize("mode", _MODES)
+def test_compressed_ring_tiny_segments(monkeypatch, mode):
+    """HOROVOD_RING_SEGMENT_BYTES=1 (clamped to one element) exercises
+    every segment-boundary edge in the compressed exchange."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, mode)
+    monkeypatch.setenv(env_mod.HOROVOD_RING_SEGMENT_BYTES, "1")
+    size, n = 3, 13
+    inputs = [_int_valued(n, r, np.float32) for r in range(size)]
+    expected = _expected_sum(inputs, np.float32)
+    outs = _compressed_allreduce([x.copy() for x in inputs])
+    for out in outs:
+        assert np.array_equal(out, expected)
+
+
+@pytest.mark.parametrize("mode", _MODES)
+def test_compressed_wire_bytes_are_half(monkeypatch, mode):
+    """THE bandwidth claim, counter-asserted: f32 allreduce with a
+    2-byte wire dtype puts exactly HALF the uncompressed payload bytes
+    on the wire (digest-check frames are excluded from bytes_on_wire by
+    design, so the ratio is exact, not approximate)."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, mode)
+    size, n = 3, 999
+    dtype = np.dtype(np.float32)
+    inputs = [_int_valued(n, r, dtype) for r in range(size)]
+
+    before = wire_stats.snapshot()
+    _compressed_allreduce([x.copy() for x in inputs])
+    after = wire_stats.snapshot()
+
+    bounds = cpu_ring._chunk_bounds(n, size)
+    sent_elems = 0
+    for idx in range(size):
+        for s in range(size - 1):
+            c = (idx - s) % size
+            sent_elems += int(bounds[c + 1] - bounds[c])
+            c = (idx + 1 - s) % size
+            sent_elems += int(bounds[c + 1] - bounds[c])
+    uncompressed = 2 * sent_elems * dtype.itemsize
+    got = after.get("bytes_on_wire", 0) - before.get("bytes_on_wire", 0)
+    assert got == uncompressed // 2, (got, uncompressed)
+    comp_bytes = (after.get("compressed_bytes", 0)
+                  - before.get("compressed_bytes", 0))
+    assert comp_bytes >= got  # every wire byte passed through a cast
+
+
+@pytest.mark.parametrize("mode", _MODES)
+def test_compressed_steady_state_zero_heap_copies(monkeypatch, mode):
+    """The zero-copy guard holds WITH compression: casts go through
+    persistent keyed arenas ("wire-send"/"wire-recv"/"wire-quant"), so a
+    steady-state compressed ring step still materializes nothing."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, mode)
+    size, n = 3, 999
+    dtype = np.dtype(np.float32)
+    fbms = [cpu_ring.FusionBufferManager() for _ in range(size)]
+    inputs = [_int_valued(n, r, dtype) for r in range(size)]
+
+    _compressed_allreduce([x.copy() for x in inputs], fbms)  # warm
+
+    before = wire_stats.snapshot()
+    outs = _compressed_allreduce([x.copy() for x in inputs], fbms)
+    after = wire_stats.snapshot()
+
+    assert np.array_equal(outs[0], _expected_sum(inputs, dtype))
+    assert after.get("heap_copies", 0) == before.get("heap_copies", 0), \
+        "a compressed steady-state ring step materialized payload bytes"
+
+
+def test_compression_with_crc_and_chaos_corrupt(monkeypatch):
+    """Corrupt injected on a COMPRESSED deferred frame is still caught by
+    the step digest: integrity composes with compression."""
+    from horovod_tpu.common import faults
+    from horovod_tpu.common.exceptions import (CoordinatedAbortError,
+                                               FrameCorruptError,
+                                               HorovodInternalError)
+
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_COMPRESSION, "fp16")
+    size = 2
+    inputs = [_int_valued(101, r, np.float32) for r in range(size)]
+    arrays = [x.copy() for x in inputs]
+    store = MemoryStore()
+    faults.configure("tcp.send:rank=0:nth=1:action=corrupt,2")
+    try:
+        def fn(rank):
+            mesh = TcpMesh(rank, size, store, bind_addr="127.0.0.1",
+                           advertise_addr="127.0.0.1", timeout=10)
+            try:
+                buf = arrays[rank]
+                wide = cpu_ring._accum_dtype(buf.dtype)
+                comp = wire_compressor_for(buf.dtype)
+                group = list(range(size))
+                bounds = cpu_ring._ring_reduce_scatter(
+                    mesh, buf, group, rank, wide, None, compressor=comp)
+                own = (rank + 1) % size
+                cpu_ring._quantize_owned(
+                    comp, buf[bounds[own]:bounds[own + 1]], None)
+                cpu_ring._ring_allgather_chunks(
+                    mesh, buf, group, rank, bounds, None, compressor=comp)
+            finally:
+                mesh.close()
+
+        with pytest.raises((FrameCorruptError, CoordinatedAbortError,
+                            HorovodInternalError)) as ei:
+            run_ranks(size, fn, timeout=30)
+        assert "wire CRC" in str(ei.value) or "abort" in str(ei.value)
+    finally:
+        faults.reset()

@@ -1,0 +1,289 @@
+"""Shadow (deferred) wire digests: algorithm properties and the
+transport's digest-check frame protocol.
+
+The load-bearing property for ``HOROVOD_WIRE_DIGEST=crc32`` is that a
+chain of per-frame ``zlib.crc32`` updates over ANY segmentation equals
+the crc32 of the concatenated bytes — that is what lets sender and
+receiver agree without ever materializing the whole transfer.  fold64 is
+not a streaming digest (it chains per-frame digests), so its contract is
+different: both endpoints fold the same frame boundaries, and any
+corruption/reorder/split change flips the value.
+"""
+
+import random
+import zlib
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import env as env_mod
+from horovod_tpu.common.exceptions import (FrameCorruptError,
+                                           HorovodInternalError)
+from horovod_tpu.transport import digest as digest_mod
+from horovod_tpu.transport.digest import (ALGO_CRC32, ALGO_FOLD64,
+                                          StreamDigest, algo_from_name)
+
+pytestmark = pytest.mark.smoke
+
+
+def _random_splits(rng, data):
+    """Cut `data` into a random number of contiguous frames (some may be
+    empty — zero-length frames never go on the wire, but the digest must
+    still tolerate short tails and single-byte frames)."""
+    cuts = sorted(rng.randrange(len(data) + 1)
+                  for _ in range(rng.randrange(1, 8)))
+    bounds = [0] + cuts + [len(data)]
+    return [data[bounds[i]:bounds[i + 1]] for i in range(len(bounds) - 1)
+            if bounds[i + 1] > bounds[i]]
+
+
+def test_crc32_chain_equals_whole_buffer_digest():
+    """THE property: chained per-frame crc32 == crc32 of the concatenated
+    payload, for random payloads cut at random frame boundaries."""
+    rng = random.Random(0x9E37)
+    for trial in range(50):
+        data = rng.randbytes(rng.randrange(1, 4096))
+        whole = zlib.crc32(data) & 0xFFFFFFFF
+        dig = StreamDigest(ALGO_CRC32)
+        for frame in _random_splits(rng, data):
+            dig.update(frame)
+        assert dig.value() == whole, trial
+
+
+def test_crc32_chain_matches_across_different_segmentations():
+    rng = random.Random(7)
+    data = rng.randbytes(10_000)
+    values = set()
+    for _ in range(10):
+        dig = StreamDigest(ALGO_CRC32)
+        for frame in _random_splits(rng, data):
+            dig.update(frame)
+        values.add(dig.value())
+    assert values == {zlib.crc32(data) & 0xFFFFFFFF}
+
+
+def test_fold64_same_frames_agree():
+    """Sender and receiver fold identical frame boundaries — the chains
+    must agree, including odd tails that exercise the zero-padded word."""
+    rng = random.Random(1)
+    for n in (1, 7, 8, 9, 63, 64, 65, 4096, 4099):
+        data = rng.randbytes(n)
+        frames = _random_splits(rng, data)
+        a, b = StreamDigest(ALGO_FOLD64), StreamDigest(ALGO_FOLD64)
+        for f in frames:
+            a.update(f)
+            b.update(f)
+        assert a.value() == b.value()
+        assert a.frames == b.frames == len(frames)
+
+
+def test_fold64_detects_single_bit_flips():
+    rng = random.Random(2)
+    data = bytearray(rng.randbytes(1024))
+    ref = StreamDigest(ALGO_FOLD64)
+    ref.update(bytes(data))
+    for _ in range(64):
+        i = rng.randrange(len(data))
+        bit = 1 << rng.randrange(8)
+        data[i] ^= bit
+        dig = StreamDigest(ALGO_FOLD64)
+        dig.update(bytes(data))
+        assert dig.value() != ref.value(), f"missed flip at byte {i}"
+        data[i] ^= bit  # restore
+
+
+def test_fold64_is_order_sensitive():
+    """Swapped frames must change the chain (the multiplicative chain
+    step exists exactly for this — a plain sum would commute)."""
+    a, b = b"x" * 100, b"y" * 100
+    d1, d2 = StreamDigest(ALGO_FOLD64), StreamDigest(ALGO_FOLD64)
+    d1.update(a)
+    d1.update(b)
+    d2.update(b)
+    d2.update(a)
+    assert d1.value() != d2.value()
+
+
+def test_fold64_framing_is_part_of_the_digest():
+    """The same bytes split differently give a different fold64 chain —
+    frame boundaries are protocol state, so a misframed stream cannot
+    collide with the honest one by construction."""
+    data = b"q" * 256
+    d1, d2 = StreamDigest(ALGO_FOLD64), StreamDigest(ALGO_FOLD64)
+    d1.update(data)
+    d2.update(data[:100])
+    d2.update(data[100:])
+    assert d1.value() != d2.value()
+
+
+def test_fold64_low_entropy_payloads_spread():
+    """All-zeros vs all-ones vs length variants must not collide (the
+    golden-ratio mix term covers degenerate word sums)."""
+    vals = set()
+    for payload in (b"\x00" * 64, b"\x00" * 72, b"\xff" * 64, b"\x01" * 64):
+        d = StreamDigest(ALGO_FOLD64)
+        d.update(payload)
+        vals.add(d.value())
+    assert len(vals) == 4
+
+
+def test_digest_accepts_views_and_arrays():
+    arr = np.arange(16, dtype=np.float64)
+    d1, d2 = StreamDigest(ALGO_FOLD64), StreamDigest(ALGO_FOLD64)
+    d1.update(arr.tobytes())
+    d2.update(memoryview(arr).cast("B"))
+    assert d1.value() == d2.value()
+
+
+def test_algo_names_round_trip():
+    assert algo_from_name("crc32") == ALGO_CRC32
+    assert algo_from_name("fold64") == ALGO_FOLD64
+    with pytest.raises(HorovodInternalError):
+        algo_from_name("md5")
+    with pytest.raises(HorovodInternalError):
+        StreamDigest(99)
+
+
+# ---------------------------------------------------------------------------
+# transport protocol: deferred frames + the digest-check frame
+# ---------------------------------------------------------------------------
+
+
+def _mesh_pair():
+    from horovod_tpu.transport import MemoryStore, TcpMesh
+
+    from .test_transport import run_ranks
+
+    store = MemoryStore()
+
+    def make(rank):
+        return TcpMesh(rank, 2, store, bind_addr="127.0.0.1",
+                       advertise_addr="127.0.0.1", timeout=10)
+
+    return run_ranks(2, make)
+
+
+@pytest.mark.parametrize("algo", ["fold64", "crc32"])
+def test_deferred_frames_round_trip_and_verify(monkeypatch, algo):
+    """Segment frames with deferred digests land correctly and the
+    digest-check frame closes the step cleanly for both algorithms."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_DIGEST, algo)
+    m0, m1 = _mesh_pair()
+    try:
+        assert m0.deferred_digests and m1.deferred_digests
+        payloads = [np.arange(64, dtype=np.float32) * (i + 1)
+                    for i in range(3)]
+
+        sdig, rdig = m0.new_digest(), m1.new_digest()
+        for p in payloads:
+            m0.send(1, memoryview(p).cast("B"), digest=sdig)
+        m0.send_step_digest(1, sdig, len(payloads))
+
+        for p in payloads:
+            dest = np.empty_like(p)
+            m1.recv_into(0, memoryview(dest).cast("B"), digest=rdig)
+            assert np.array_equal(dest, p)
+        m1.verify_step_digest(0, rdig, len(payloads))  # must not raise
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_deferred_digest_catches_corruption():
+    """A corrupt injected on a deferred frame's wire bytes sails through
+    the (absent) inline CRC but MUST be caught by the step digest —
+    detection granularity changed, the guarantee did not."""
+    from horovod_tpu.common import faults
+
+    m0, m1 = _mesh_pair()
+    try:
+        faults.configure("tcp.send:rank=0:nth=2:action=corrupt,3")
+        sdig, rdig = m0.new_digest(), m1.new_digest()
+        payloads = [np.full(32, float(i), np.float32) for i in range(3)]
+        for p in payloads:
+            m0.send(1, memoryview(p).cast("B"), digest=sdig)
+        m0.send_step_digest(1, sdig, len(payloads))
+        for p in payloads:
+            dest = np.empty_like(p)
+            m1.recv_into(0, memoryview(dest).cast("B"), digest=rdig)
+        with pytest.raises(FrameCorruptError) as ei:
+            m1.verify_step_digest(0, rdig, len(payloads))
+        assert "wire CRC" in str(ei.value)
+    finally:
+        faults.reset()
+        m0.close()
+        m1.close()
+
+
+def test_shadow_knob_skew_fails_loudly(monkeypatch):
+    """One peer deferring while the other expects inline CRC must poison
+    the stream (mixed-config mesh), not silently mis-read."""
+    m0, m1 = _mesh_pair()
+    try:
+        sdig = m0.new_digest()
+        p = np.arange(16, dtype=np.float32)
+        m0.send(1, memoryview(p).cast("B"), digest=sdig)  # deferred frame
+        dest = np.empty_like(p)
+        with pytest.raises(Exception) as ei:
+            m1.recv_into(0, memoryview(dest).cast("B"))  # expects inline
+        assert "HOROVOD_WIRE_CRC_SHADOW" in str(ei.value)
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_wire_dtype_skew_fails_loudly():
+    """A frame stamped with a wire dtype the receiver is not configured
+    for must abort (HOROVOD_WIRE_COMPRESSION skew), never mis-decode."""
+    m0, m1 = _mesh_pair()
+    try:
+        sdig, rdig = m0.new_digest(), m1.new_digest()
+        p = np.arange(16, dtype=np.float16)
+        m0.send(1, memoryview(p).cast("B"), digest=sdig, wire_dtype=1)
+        dest = np.empty_like(p)
+        with pytest.raises(Exception) as ei:
+            m1.recv_into(0, memoryview(dest).cast("B"), digest=rdig,
+                         wire_dtype=0)
+        assert "HOROVOD_WIRE_COMPRESSION" in str(ei.value)
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_digest_algo_skew_fails_loudly(monkeypatch):
+    """The check frame carries the algorithm code; a peer verifying with
+    a different HOROVOD_WIRE_DIGEST must abort loudly."""
+    m0, m1 = _mesh_pair()
+    try:
+        sdig = digest_mod.StreamDigest(ALGO_CRC32)
+        rdig = m1.new_digest()  # fold64 default
+        assert rdig.algo == ALGO_FOLD64
+        p = np.arange(8, dtype=np.float32)
+        m0.send(1, memoryview(p).cast("B"), digest=sdig)
+        m0.send_step_digest(1, sdig, 1)
+        dest = np.empty_like(p)
+        m1.recv_into(0, memoryview(dest).cast("B"), digest=rdig)
+        with pytest.raises(Exception) as ei:
+            m1.verify_step_digest(0, rdig, 1)
+        assert "HOROVOD_WIRE_DIGEST" in str(ei.value)
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_shadow_off_restores_inline_crc(monkeypatch):
+    """HOROVOD_WIRE_CRC_SHADOW=0: the ring passes no digests and every
+    frame carries the inline CRC again (the PR-4 behavior)."""
+    monkeypatch.setenv(env_mod.HOROVOD_WIRE_CRC_SHADOW, "0")
+    m0, m1 = _mesh_pair()
+    try:
+        assert not m0.deferred_digests
+        assert m0.new_digest() is not None  # digests still constructible
+        p = np.arange(16, dtype=np.float32)
+        m0.send(1, memoryview(p).cast("B"))
+        dest = np.empty_like(p)
+        m1.recv_into(0, memoryview(dest).cast("B"))
+        assert np.array_equal(dest, p)
+    finally:
+        m0.close()
+        m1.close()
