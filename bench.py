@@ -39,6 +39,35 @@ _PEAK_FLOPS = {
 # (MAC=2); training ~3x forward.
 _RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9
 
+def _control_block() -> dict:
+    """Same-session control: a fixed host workload (f32 512×512 matmul
+    chain) timed right next to the headline number.  BENCH numbers on this
+    shared box must only be compared against a same-session control
+    (ROADMAP cross-cutting note) — the ratio headline/control is
+    comparable across rounds even when the box itself speeds up or slows
+    down; raw cross-round comparisons are not.  Median of 3 to shed
+    scheduler noise; ~100 ms total."""
+    import numpy as np
+
+    a0 = np.random.RandomState(1).rand(512, 512).astype(np.float32)
+    reps, times = 20, []
+    for _ in range(3):
+        a = a0.copy()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            a = a @ a0
+            a /= np.abs(a).max() + 1.0  # keep values finite
+        times.append(time.perf_counter() - t0)
+    med = sorted(times)[1]
+    return {
+        "workload": "host_matmul_f32_512x512",
+        "reps": reps,
+        "median_s": round(med, 5),
+        "gflops": round(2 * 512 ** 3 * reps / med / 1e9, 2),
+        "host_cpus": os.cpu_count(),
+    }
+
+
 # The output contract is ONE JSON line, even when the watchdog thread and
 # the main thread race to report (success-vs-hang, error-vs-hang): every
 # record goes through _emit, first writer wins.
@@ -238,6 +267,7 @@ def main() -> None:
         "flops_source": flops_source,
         "batch_size": batch_size,
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+        "control": _control_block(),
     }
     if error:
         record["error"] = error

@@ -1,0 +1,173 @@
+"""Same-session A/B benchmark harness with a noise-aware verdict.
+
+Generalizes ``allreduce_bench.py``'s interleaved-medians idiom (the box's
+bench-noise discipline: ±20% run-to-run drift, so variants are sampled
+A B, A B, ... and only medians compared) into a reusable gate:
+
+1. run control and candidate configs INTERLEAVED for ``--repeats`` pairs,
+2. report the median step time of each,
+3. issue a verdict from a paired **sign test**: count the pairs where the
+   candidate beat its same-pair control; under the no-difference null the
+   count is Binomial(n, ½), and a two-sided p-value below ``--alpha``
+   declares "improvement" or "regression" — anything else is
+   "no significant difference".  Medians say *how big*, the sign test
+   says *whether it's real*; a shared box's slow drift hits both arms of
+   a pair equally, which is the whole point of interleaving.
+
+With the defaults (6 pairs, α=0.05) a unanimous 6/6 sweep is the only
+significant outcome (p = 2·(½)⁶ ≈ 0.031) — deliberately conservative for
+a noisy box.
+
+The workload is the eager-allreduce step (``allreduce_bench._measure``:
+slowest-rank per-step seconds at a given payload × world size); control
+and candidate differ only in environment overlays.
+
+Usage::
+
+    python benchmarks/ab_harness.py --label aa            # A/A null check
+    python benchmarks/ab_harness.py --label crc-off \\
+        --candidate HOROVOD_WIRE_CRC=0 \\
+        --out benchmarks/results/ab_crc_off.json
+    python benchmarks/ab_harness.py --label rank1-delay \\
+        --candidate "HOROVOD_FAULT_SPEC=enqueue.collective:rank=1:action=delay_ms,5"
+
+``ci/bench_gate.sh`` runs the A/A and an injected-slowdown case and
+asserts the two verdicts; artifacts land in ``benchmarks/results/ab_*.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import statistics
+import sys
+from typing import Callable, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def sign_test_p(wins: int, losses: int) -> float:
+    """Two-sided paired sign-test p-value (ties already excluded): the
+    probability, under Binomial(n, ½), of a split at least this lopsided."""
+    n = wins + losses
+    if n == 0:
+        return 1.0
+    k = min(wins, losses)
+    tail = sum(math.comb(n, i) for i in range(k + 1)) / 2.0 ** n
+    return min(1.0, 2.0 * tail)
+
+
+def ab_compare(measure: Callable[[Optional[Dict[str, str]]], float],
+               control_env: Optional[Dict[str, str]],
+               candidate_env: Optional[Dict[str, str]],
+               repeats: int = 6, alpha: float = 0.05) -> dict:
+    """Interleaved paired comparison; ``measure(env)`` returns one step
+    time in seconds.  Returns the verdict record (see module docstring)."""
+    pairs: List[tuple] = []
+    for _ in range(repeats):
+        a = measure(control_env)
+        b = measure(candidate_env)
+        pairs.append((a, b))
+    med_a = statistics.median(a for a, _ in pairs)
+    med_b = statistics.median(b for _, b in pairs)
+    wins = sum(1 for a, b in pairs if b < a)     # candidate faster
+    losses = sum(1 for a, b in pairs if b > a)   # candidate slower
+    p = sign_test_p(wins, losses)
+    if p < alpha:
+        verdict = "improvement" if wins > losses else "regression"
+    else:
+        verdict = "no significant difference"
+    return {
+        "metric": "ab_compare",
+        "repeats": repeats,
+        "alpha": alpha,
+        "median_control_ms": round(med_a * 1e3, 3),
+        "median_candidate_ms": round(med_b * 1e3, 3),
+        "candidate_over_control": round(med_b / med_a, 3),
+        "wins": wins,
+        "losses": losses,
+        "ties": repeats - wins - losses,
+        "p_value": round(p, 5),
+        "verdict": verdict,
+        "samples_ms": {
+            "control": [round(a * 1e3, 3) for a, _ in pairs],
+            "candidate": [round(b * 1e3, 3) for _, b in pairs],
+        },
+    }
+
+
+def _parse_env(items: List[str]) -> Dict[str, str]:
+    env = {}
+    for item in items:
+        if "=" not in item:
+            raise SystemExit(f"ab_harness: --control/--candidate entries "
+                             f"must be KEY=VALUE, got {item!r}")
+        k, v = item.split("=", 1)
+        env[k] = v
+    return env
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description="same-session interleaved A/B gate over the eager "
+                    "allreduce step (docs/observability.md)")
+    p.add_argument("--label", required=True,
+                   help="short name for this comparison (artifact key)")
+    p.add_argument("--control", nargs="*", default=[], metavar="K=V",
+                   help="env overlay for the control arm (default: none)")
+    p.add_argument("--candidate", nargs="*", default=[], metavar="K=V",
+                   help="env overlay for the candidate arm")
+    p.add_argument("--nbytes", type=int, default=1 << 22,
+                   help="allreduce payload bytes (default: 4 MiB)")
+    p.add_argument("--np", dest="np_", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=10,
+                   help="allreduce rounds per sample")
+    p.add_argument("--repeats", type=int, default=6,
+                   help="interleaved A/B pairs (6 ⇒ only a unanimous "
+                        "sweep is significant at the default alpha)")
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--out", default=None,
+                   help="write the verdict record to this JSON file")
+    args = p.parse_args()
+
+    import allreduce_bench
+
+    # allreduce_bench is imported from benchmarks/ (not run as __main__),
+    # so its _worker would pickle BY REFERENCE — and the spawned workers
+    # cannot import a module that only exists on this process's sys.path.
+    # Ship it by value instead.
+    try:
+        import cloudpickle
+        cloudpickle.register_pickle_by_value(allreduce_bench)
+    except (ImportError, AttributeError):
+        pass
+
+    def measure(env):
+        return allreduce_bench._measure(args.nbytes, args.np_, args.rounds,
+                                        env)
+
+    rec = ab_compare(measure, _parse_env(args.control) or None,
+                     _parse_env(args.candidate) or None,
+                     repeats=args.repeats, alpha=args.alpha)
+    rec.update({
+        "label": args.label,
+        "control_env": _parse_env(args.control),
+        "candidate_env": _parse_env(args.candidate),
+        "payload_bytes": args.nbytes,
+        "world_size": args.np_,
+        "rounds": args.rounds,
+        "host_cpus": os.cpu_count(),
+    })
+    print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

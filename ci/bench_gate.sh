@@ -1,0 +1,68 @@
+#!/bin/sh
+# Bench gate (docs/observability.md, A/B harness): proves the
+# same-session A/B verdict machinery end to end on this box.
+#
+#   1. A/A null check — identical control and candidate must come back
+#      "no significant difference" (the sign test's false-positive rate
+#      at the defaults is ~3%, so one unlucky unanimous sweep is retried
+#      once before failing the lane);
+#   2. injected slowdown — a delay_ms fault on rank 1's collective
+#      submission (the enqueue.collective site, docs/fault_injection.md)
+#      must come back "regression".
+#
+# Artifacts land in benchmarks/results/ab_aa_gate.json and
+# benchmarks/results/ab_rank1_delay_gate.json.
+#
+#   sh ci/bench_gate.sh
+set -eu
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+NBYTES="${BENCH_GATE_NBYTES:-4194304}"
+ROUNDS="${BENCH_GATE_ROUNDS:-10}"
+# 5 ms on every rank-1 submission inflates the ~tens-of-ms 4 MiB np=2
+# step deterministically (~20%) — every pair votes "slower".
+DELAY_SPEC="enqueue.collective:rank=1:action=delay_ms,5"
+
+check_verdict() {
+    # check_verdict FILE EXPECTED
+    python - "$1" "$2" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+got, want = rec["verdict"], sys.argv[2]
+print(f"bench-gate: {rec['label']}: verdict={got!r} "
+      f"(control={rec['median_control_ms']}ms "
+      f"candidate={rec['median_candidate_ms']}ms p={rec['p_value']})")
+sys.exit(0 if got == want else 1)
+EOF
+}
+
+run_case() {
+    # run_case LABEL EXPECTED OUT [candidate K=V...]
+    label="$1"; expected="$2"; out="$3"; shift 3
+    attempt=1
+    while :; do
+        JAX_PLATFORMS=cpu python benchmarks/ab_harness.py \
+            --label "$label" --nbytes "$NBYTES" --rounds "$ROUNDS" \
+            --out "$out" "$@" > /dev/null
+        if check_verdict "$out" "$expected"; then
+            return 0
+        fi
+        [ "$attempt" -ge 2 ] && {
+            echo "bench-gate: $label FAILED (wanted $expected twice)"
+            return 1
+        }
+        echo "bench-gate: $label verdict mismatch, retrying once"
+        attempt=$((attempt + 1))
+    done
+}
+
+mkdir -p benchmarks/results
+rc=0
+run_case aa-null "no significant difference" \
+    benchmarks/results/ab_aa_gate.json || rc=$?
+run_case rank1-delay regression \
+    benchmarks/results/ab_rank1_delay_gate.json \
+    --candidate "HOROVOD_FAULT_SPEC=$DELAY_SPEC" || rc=$?
+[ "$rc" -eq 0 ] || { echo "bench gate FAILED (rc=$rc)"; exit "$rc"; }
+echo "bench gate PASSED"

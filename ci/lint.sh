@@ -20,3 +20,8 @@ rc=0
 cat ci/lint.last.log
 [ "$rc" -eq 0 ] || { echo "lint lane FAILED (rc=$rc)"; exit "$rc"; }
 echo "lint lane PASSED"
+
+# Strict live-scrape validation rides the lint lane (same "fail in
+# seconds, not in the chaos lane" rationale): one np=2 smoke job, its
+# GET /metrics output checked line by line against the catalog.
+sh ci/metrics_smoke.sh

@@ -150,11 +150,27 @@ HOROVOD_METRICS_PUSH_SECS = "HOROVOD_METRICS_PUSH_SECS"
 # post-mortem JSON when the background loop dies (coordinated abort,
 # frame corruption, any fatal error).
 HOROVOD_FLIGHT_RECORDER = "HOROVOD_FLIGHT_RECORDER"
-# Directory the post-mortem dumps land in (default: the worker's cwd —
-# next to its logs); file name hvd_flight_recorder.rank<N>.json.
+# Base directory the post-mortem dumps land in; dumps go into an
+# hvd_flight_recorder/ subdirectory of it (created on demand) so they
+# never litter the job's cwd.  Default base: the worker's cwd; file name
+# hvd_flight_recorder/hvd_flight_recorder.rank<N>.json.
 HOROVOD_FLIGHT_RECORDER_DIR = "HOROVOD_FLIGHT_RECORDER_DIR"
 # Ring capacity (events retained; oldest evicted first).
 HOROVOD_FLIGHT_RECORDER_EVENTS = "HOROVOD_FLIGHT_RECORDER_EVENTS"
+# Straggler detector (coordinator-side, docs/observability.md): a rank
+# whose readiness-lag EWMA — how long it keeps tensors waiting after the
+# median announcer is ready — exceeds this many seconds is flagged as a
+# straggler suspect (metrics + flight-recorder event + log line naming
+# the rank).  0 disables flagging; lag EWMAs still update.
+HOROVOD_STRAGGLER_THRESHOLD_SECS = "HOROVOD_STRAGGLER_THRESHOLD_SECS"
+# EWMA smoothing factor in (0, 1] for the per-rank readiness lag: higher
+# reacts faster, lower rides out one-cycle noise.
+HOROVOD_STRAGGLER_EWMA_ALPHA = "HOROVOD_STRAGGLER_EWMA_ALPHA"
+# Per-tensor lifecycle spans in the timeline ("1"/"0", default on):
+# submitted → negotiated → fused → wire → reduced → callback spans on
+# every rank.  Only consulted when a timeline is active; costs one
+# module-attribute read otherwise.
+HOROVOD_TIMELINE_LIFECYCLE = "HOROVOD_TIMELINE_LIFECYCLE"
 
 # -- core runtime tunables (reference common.h:64-91) --
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"  # bytes, default 64MB
@@ -229,6 +245,13 @@ DEFAULT_METRICS_PUSH_SECS = 5.0
 # (faults, epoch changes, aborts) — sized so idle control-frame chatter
 # cannot evict a whole incident's history.
 DEFAULT_FLIGHT_RECORDER_EVENTS = 512
+# 5 s: far above any healthy cycle's skew on a loaded CI box (negotiation
+# cycles are ~ms), far below the 60 s stall warning — the detector names
+# the lagging rank while the job is still making (slow) progress.
+DEFAULT_STRAGGLER_THRESHOLD_SECS = 5.0
+# 0.25: a sustained lag reaches ~90% of its value within 8 lagging
+# cycles, while a single slow cycle decays below threshold immediately.
+DEFAULT_STRAGGLER_EWMA_ALPHA = 0.25
 # 512 ops between compactions: elastic churn writes ~2N keys per epoch,
 # so replay stays bounded at a few epochs' worth of ops even at np=64
 # while steady-state lease renewals don't compact every few seconds.

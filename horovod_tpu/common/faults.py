@@ -11,7 +11,8 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, clauses joined by ``;``)::
 
     clause  := site[:key=value]...
     site    := tcp.send | tcp.recv | controller.negotiate |
-               dispatch.collective | rendezvous.get | worker.spawn |
+               enqueue.collective | dispatch.collective |
+               rendezvous.get | worker.spawn |
                ckpt.save | store.put | store.get_serve | driver.tick
     keys    := rank=N       only fire on this Horovod rank
                peer=N       only fire when the op targets this peer rank
@@ -72,6 +73,7 @@ SITES = (
     "tcp.send",
     "tcp.recv",
     "controller.negotiate",
+    "enqueue.collective",
     "dispatch.collective",
     "rendezvous.get",
     "worker.spawn",

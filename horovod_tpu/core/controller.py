@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Set
 
 from ..common import faults
 from ..common.logging_util import get_logger
+from . import flight_recorder
 from ..common.topology import ProcessTopology
 from ..transport.tcp import TcpMesh
 from . import metrics
@@ -111,6 +112,11 @@ class _TableEntry:
     requests: List[Request] = field(default_factory=list)
     ranks: Set[int] = field(default_factory=set)
     first_seen: float = field(default_factory=time.monotonic)
+    # When the MEDIAN announcer became ready (the instant half the active
+    # ranks had tallied): the straggler detector measures the remaining
+    # ranks' lag from here, not from first_seen, so one early rank cannot
+    # smear everyone else as "behind".
+    majority_seen: Optional[float] = None
 
 
 class Controller:
@@ -159,6 +165,9 @@ class Controller:
         # allreduce role, ``mpi_controller.cc:88-106``).
         self._pending_masks: Dict[int, int] = {}
         self._mask_bit_since: Dict[int, float] = {}
+        # When each leftover bit reached majority announcement (the mask
+        # path's majority_seen analog); keyed like _mask_bit_since.
+        self._mask_bit_majority: Dict[int, float] = {}
         # Tensors completed by a stall-time bit→table conversion (after this
         # cycle's responses were already built); delivered next cycle.
         self._stall_completed: List[str] = []
@@ -193,6 +202,26 @@ class Controller:
             raise ValueError(
                 f"HOROVOD_FUSION_ORDER={order!r}: expected readiness|arrival")
         self.fusion_order = order
+        # Online straggler detection (coordinator-side, single-threaded —
+        # all state below is touched only from the coordinator's own cycle
+        # path, so the hot path gains no locks).  Per-rank EWMAs of how
+        # long each rank keeps tensors waiting past the median announcer;
+        # crossing the threshold flags the rank (metrics + flight-recorder
+        # event + log line).  docs/observability.md#straggler-detection.
+        self.straggler_threshold = env_mod.get_float(
+            env_mod.HOROVOD_STRAGGLER_THRESHOLD_SECS,
+            env_mod.DEFAULT_STRAGGLER_THRESHOLD_SECS)
+        alpha = env_mod.get_float(env_mod.HOROVOD_STRAGGLER_EWMA_ALPHA,
+                                  env_mod.DEFAULT_STRAGGLER_EWMA_ALPHA)
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(
+                f"HOROVOD_STRAGGLER_EWMA_ALPHA={alpha!r}: expected (0, 1]")
+        self.straggler_alpha = alpha
+        self._straggler_ewma: Dict[int, float] = {}
+        self._straggler_suspects: Set[int] = set()
+        # False while every EWMA sits at zero and nothing lags: the
+        # per-cycle update early-outs to two dict checks in steady state.
+        self._straggler_decaying = False
 
     # ------------------------------------------------------------------
     # the per-cycle negotiation round
@@ -390,6 +419,7 @@ class Controller:
         responses.extend(mask_responses)
         tuned = self._autotune(responses)
         responses = self._fuse_responses(responses)
+        self._update_stragglers()
         self._check_stalls()
         if self._cache is not None:
             self._cache.tick()
@@ -503,6 +533,7 @@ class Controller:
                             completed |= self._increment(
                                 _replace(tpl, request_rank=r))
                 self._mask_bit_since.pop(bit, None)
+                self._mask_bit_majority.pop(bit, None)
                 if completed:
                     resp = self._construct_response(tpl.tensor_name)
                     if resp is not None:
@@ -537,6 +568,7 @@ class Controller:
             bit = low.bit_length() - 1
             rm ^= low
             self._mask_bit_since.pop(bit, None)
+            self._mask_bit_majority.pop(bit, None)
             tpl = self._cache.rehydrate(bit, 0) if self._cache else None
             if tpl is None:
                 log.error("ready unknown cache bit %d; dropping", bit)
@@ -568,6 +600,10 @@ class Controller:
                 bit = low.bit_length() - 1
                 leftover ^= low
                 self._mask_bit_since.setdefault(bit, now)
+                if bit not in self._mask_bit_majority:
+                    have = sum(1 for m in pending.values() if m & low)
+                    if 2 * have >= self.topo.size - len(self._joined_ranks):
+                        self._mask_bit_majority[bit] = now
                 tpl = self._cache.rehydrate(bit, 0) if self._cache else None
                 if tpl is None:
                     log.error("pending unknown cache bit %d; dropping", bit)
@@ -583,6 +619,7 @@ class Controller:
                             completed |= self._increment(
                                 _replace(tpl, request_rank=r))
                     self._mask_bit_since.pop(bit, None)
+                    self._mask_bit_majority.pop(bit, None)
                     if completed:
                         resp = self._construct_response(tpl.tensor_name)
                         if resp is not None:
@@ -595,6 +632,7 @@ class Controller:
             if m & low:
                 self._pending_masks[r] = m & ~low
         self._mask_bit_since.pop(bit, None)
+        self._mask_bit_majority.pop(bit, None)
 
     def _response_from_template(self, tpl: Request) -> Response:
         """Response for a fully-hit cached tensor — field-for-field what
@@ -674,6 +712,9 @@ class Controller:
         if self.timeline is not None:
             self.timeline.negotiate_rank_ready(req.tensor_name, req.request_rank)
         needed = self.topo.size - len(self._joined_ranks - entry.ranks)
+        if entry.majority_seen is None and \
+                2 * len(entry.ranks) >= self.topo.size - len(self._joined_ranks):
+            entry.majority_seen = time.monotonic()
         return len(entry.ranks) >= needed
 
     # ------------------------------------------------------------------
@@ -888,6 +929,92 @@ class Controller:
         return fused
 
     # ------------------------------------------------------------------
+    # straggler detection (coordinator-side; docs/observability.md)
+    # ------------------------------------------------------------------
+
+    def _update_stragglers(self) -> None:
+        """Per-cycle readiness-lag EWMAs from the tallies the coordinator
+        already keeps: a rank is *behind* by ``now - majority_seen`` for
+        every incomplete tensor (table entry or announced cache bit) whose
+        median announcer is ready but this rank is not.  Steady state —
+        every tensor completes in its announcement cycle — stamps no
+        majorities, so the whole update is two falsy checks."""
+        if not self._straggler_decaying and not self._mask_bit_majority \
+                and not any(e.majority_seen is not None
+                            for e in self._message_table.values()):
+            return
+        now = time.monotonic()
+        behind: Dict[int, float] = {}
+        active = set(range(self.topo.size)) - self._joined_ranks
+        for entry in self._message_table.values():
+            if entry.majority_seen is None:
+                continue
+            age = now - entry.majority_seen
+            for r in active - entry.ranks:
+                if age > behind.get(r, 0.0):
+                    behind[r] = age
+        for bit, since in self._mask_bit_majority.items():
+            low = 1 << bit
+            age = now - since
+            for r in active:
+                if not (self._pending_masks.get(r, 0) & low) \
+                        and age > behind.get(r, 0.0):
+                    behind[r] = age
+        ewma = self._straggler_ewma
+        thresh = self.straggler_threshold
+        decaying = False
+        for r in range(self.topo.size):
+            lag = behind.get(r, 0.0)
+            v = ewma.get(r, 0.0)
+            v += self.straggler_alpha * (lag - v)
+            ewma[r] = v
+            decaying = decaying or v > 1e-9
+            if lag > 0.0:
+                metrics.observe("straggler_lag_seconds", lag, rank=str(r))
+            if thresh <= 0.0:
+                continue
+            if v > thresh and r not in self._straggler_suspects:
+                self._straggler_suspects.add(r)
+                metrics.inc("straggler_flags_total", rank=str(r))
+                flight_recorder.record(
+                    "straggler", rank=r, lag_ewma=round(v, 6),
+                    threshold=thresh)
+                log.warning(
+                    "straggler detected: rank %d readiness-lag EWMA %.3fs "
+                    "exceeds HOROVOD_STRAGGLER_THRESHOLD_SECS=%.3fs "
+                    "(it keeps completing tensors %0.3fs after the median "
+                    "announcer)", r, v, thresh, lag)
+                self._set_suspect_gauge()
+            elif v < thresh / 2.0 and r in self._straggler_suspects:
+                # Hysteresis: clear at half the flag threshold so a rank
+                # oscillating near it doesn't spam flag transitions.
+                self._straggler_suspects.discard(r)
+                flight_recorder.record("straggler_cleared", rank=r,
+                                       lag_ewma=round(v, 6))
+                log.info("straggler cleared: rank %d readiness-lag EWMA "
+                         "back to %.3fs", r, v)
+                self._set_suspect_gauge()
+        self._straggler_decaying = decaying or bool(self._straggler_suspects)
+
+    def _set_suspect_gauge(self) -> None:
+        worst = max(self._straggler_suspects,
+                    key=lambda r: self._straggler_ewma.get(r, 0.0)) \
+            if self._straggler_suspects else -1
+        metrics.set_gauge("straggler_suspect", worst)
+
+    def _lag_suffix(self, missing: List[int]) -> str:
+        """Name the laggard for the stall-inspector warnings: the missing
+        rank with the worst readiness-lag EWMA (empty when no lag has been
+        observed — e.g. a rank that never announced anything)."""
+        candidates = [r for r in missing
+                      if self._straggler_ewma.get(r, 0.0) > 1e-9]
+        if not candidates:
+            return ""
+        worst = max(candidates, key=lambda r: self._straggler_ewma[r])
+        return (f"; slowest by readiness-lag EWMA: rank {worst} "
+                f"({self._straggler_ewma[worst]:.3f}s)")
+
+    # ------------------------------------------------------------------
     # stall inspection (coordinator-side; reference stall_inspector.cc)
     # ------------------------------------------------------------------
 
@@ -934,8 +1061,8 @@ class Controller:
             log.warning(
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcasted by subset of ranks and are waiting for the "
-                "remainder: %s stalled for %.0fs, missing ranks: %s",
-                name, age, missing)
+                "remainder: %s stalled for %.0fs, missing ranks: %s%s",
+                name, age, missing, self._lag_suffix(missing))
             # A stalled tensor's cached negotiation is stale
             # (reference InvalidateStalledCachedTensors): evict so any
             # post-recovery resubmission renegotiates from scratch.
@@ -973,13 +1100,15 @@ class Controller:
                 continue
             log.warning(
                 "cached tensor %s announced by ranks %s stalled for %.0fs, "
-                "missing ranks: %s — invalidating its cache entry",
-                tpl.tensor_name, have, age, missing)
+                "missing ranks: %s%s — invalidating its cache entry",
+                tpl.tensor_name, have, age, missing,
+                self._lag_suffix(missing))
             for r in have:
                 self._pending_masks[r] &= ~(1 << bit)
                 if self._increment(_replace(tpl, request_rank=r)):
                     self._stall_completed.append(tpl.tensor_name)
             self._mask_bit_since.pop(bit, None)
+            self._mask_bit_majority.pop(bit, None)
             evicted = self._cache.invalidate_name(tpl.tensor_name)
             if evicted is not None:
                 self._cycle_evictions.append(evicted)

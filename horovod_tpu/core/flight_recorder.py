@@ -80,8 +80,17 @@ class FlightRecorder:
             return None
         rank = env_mod.get_int(env_mod.HOROVOD_RANK, 0)
         if path is None:
-            dump_dir = env_mod.get_str(
-                env_mod.HOROVOD_FLIGHT_RECORDER_DIR) or "."
+            # Dumps land in an hvd_flight_recorder/ SUBDIRECTORY of the
+            # configured dir (default cwd) so an N-rank post-mortem is one
+            # self-contained folder instead of N files strewn at repo root.
+            dump_dir = os.path.join(
+                env_mod.get_str(env_mod.HOROVOD_FLIGHT_RECORDER_DIR) or ".",
+                "hvd_flight_recorder")
+            try:
+                os.makedirs(dump_dir, exist_ok=True)
+            except OSError as e:
+                log.error("flight-recorder dir %s failed: %s", dump_dir, e)
+                return None
             path = os.path.join(dump_dir, _dump_filename(rank))
         doc = {
             "format": DUMP_FORMAT,

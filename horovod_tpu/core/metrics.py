@@ -90,6 +90,19 @@ CATALOG: Dict[str, Tuple[str, str]] = {
                  "(coordinator only; updated each stall check)"),
     "stall_shutdowns_total": (
         "counter", "hard stall-shutdown aborts fired (coordinator only)"),
+    # -- straggler detector (coordinator-side; docs/observability.md) --
+    "straggler_lag_seconds": (
+        "histogram", "per-cycle readiness lag of a rank currently holding "
+                     "tensors past the median announcer, labeled rank= "
+                     "(coordinator only; lag-free cycles record nothing)"),
+    "straggler_suspect": (
+        "gauge", "rank id of the worst straggler suspect (readiness-lag "
+                 "EWMA over HOROVOD_STRAGGLER_THRESHOLD_SECS), -1 when "
+                 "no rank is flagged (coordinator only)"),
+    "straggler_flags_total": (
+        "counter", "straggler flag transitions — a rank's readiness-lag "
+                   "EWMA crossing the threshold — labeled rank= "
+                   "(coordinator only)"),
     # -- rendezvous / elastic --
     "rendezvous_store_ops_total": (
         "counter", "HTTP KV store requests, labeled op=get|set|delete|keys"),

@@ -38,6 +38,7 @@ from ..common.topology import ProcessTopology, from_env
 from ..transport.store import HTTPStoreClient, MemoryStore, Store
 from ..transport.tcp import TcpMesh
 from . import flight_recorder, metrics
+from . import timeline as timeline_mod
 from .controller import BARRIER_TENSOR_NAME, JOIN_TENSOR_NAME, Controller
 from .messages import (
     DataType,
@@ -674,6 +675,17 @@ class HorovodGlobalState:
 
         entries = self.tensor_queue.get_entries_for_response(response)
 
+        # Lifecycle spans: close each tensor's LC_SUBMITTED (opened at
+        # enqueue) and stamp the cycle-tagged LC_NEGOTIATED instant.
+        # Zero-substituted entries (built below) never enqueued, so they
+        # correctly get neither.
+        if timeline_mod.ACTIVE is not None and timeline_mod.LIFECYCLE_ENABLED:
+            cyc = getattr(response, "_cycle", None)
+            for e in entries:
+                timeline_mod.lifecycle_end(e.tensor_name, "LC_SUBMITTED")
+                timeline_mod.lifecycle_instant(e.tensor_name, "LC_NEGOTIATED",
+                                               cycle=cyc)
+
         if response.response_type == ResponseType.ERROR:
             for e in entries:
                 e.callback(Status.error(response.error_message), e)
@@ -765,7 +777,9 @@ class HorovodGlobalState:
                     lambda ents=entries: self._finalize_entries(ents))
             return
         for e in entries:
+            timeline_mod.lifecycle_begin(e.tensor_name, "LC_CALLBACK")
             e.callback(status, e)
+            timeline_mod.lifecycle_end(e.tensor_name, "LC_CALLBACK")
 
     _TIMED_RESPONSES = (ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
                         ResponseType.BROADCAST, ResponseType.ALLTOALL,
@@ -798,12 +812,15 @@ class HorovodGlobalState:
 
     @staticmethod
     def _fire_callback(e, status) -> None:
+        timeline_mod.lifecycle_begin(e.tensor_name, "LC_CALLBACK")
         try:
             e.callback(status, e)
         except Exception:  # noqa: BLE001 — a raising callback must not
             # kill the dispatching thread (later collectives would strand
             # on unfired callbacks)
             log.error("callback for %r raised", e.tensor_name, exc_info=True)
+        finally:
+            timeline_mod.lifecycle_end(e.tensor_name, "LC_CALLBACK")
 
     @staticmethod
     def _finalize_entries(entries) -> None:

@@ -22,7 +22,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..common import faults
 from ..common.exceptions import DuplicateNameError
+from . import timeline as timeline_mod
 from .messages import Request, RequestType, Response
 
 
@@ -98,6 +100,15 @@ class TensorQueue:
     def add(self, entry: TensorTableEntry, request: Request) -> None:
         from ..common.exceptions import HorovodInternalError
 
+        # The submission-side fault site: delaying here makes THIS rank a
+        # genuine compute straggler (it announces readiness cycles after
+        # its peers, which keep negotiating), unlike delays inside the
+        # lockstep negotiation/dispatch paths that stall every rank
+        # equally.  Fires before the lock — a hang/delay must not block
+        # other framework threads (HVD001).
+        if faults.ACTIVE:
+            faults.inject("enqueue.collective")
+        timeline_mod.lifecycle_begin(entry.tensor_name, "LC_SUBMITTED")
         with self._lock:
             if self._closed:
                 # The background loop has exited and drained the table; an

@@ -40,6 +40,40 @@ _WRITER_SENTINEL = None
 #: Name of the per-trace metadata record trace_merge aligns clocks on.
 CLOCK_SYNC_EVENT = "clock_sync"
 
+#: Per-tensor lifecycle spans (submitted → negotiated → fused → wire →
+#: reduced → callback) on every rank, consumed by
+#: ``tools/critical_path.py``.  Toggle-gated so the instrumented hot
+#: paths stay at one module-attribute read when off or when no timeline
+#: is active.
+LIFECYCLE_ENABLED = env_mod.get_bool(env_mod.HOROVOD_TIMELINE_LIFECYCLE, True)
+
+#: The process's live Timeline, set by the constructor and cleared by
+#: ``close()``: instrumentation sites that can't reach the global state
+#: object (tensor queue, ring backend) emit lifecycle records through the
+#: module-level helpers below instead of threading the instance through
+#: every call chain.
+ACTIVE: Optional["Timeline"] = None
+
+
+def lifecycle_begin(tensor_name: str, stage: str,
+                    cycle: Optional[int] = None) -> None:
+    tl = ACTIVE
+    if tl is not None and LIFECYCLE_ENABLED:
+        tl.lifecycle(tensor_name, stage, begin=True, cycle=cycle)
+
+
+def lifecycle_end(tensor_name: str, stage: str) -> None:
+    tl = ACTIVE
+    if tl is not None and LIFECYCLE_ENABLED:
+        tl.lifecycle(tensor_name, stage, begin=False)
+
+
+def lifecycle_instant(tensor_name: str, stage: str,
+                      cycle: Optional[int] = None) -> None:
+    tl = ACTIVE
+    if tl is not None and LIFECYCLE_ENABLED:
+        tl.lifecycle_mark(tensor_name, stage, cycle=cycle)
+
 
 def rank_trace_path(path: str, rank: int) -> str:
     """Per-rank trace file layout: rank 0 owns the configured path
@@ -104,6 +138,8 @@ class Timeline:
                     "args": {"wall_base_ns": self._wall_base_ns,
                              "server_offset_ns": clock_offset_ns,
                              "rank": rank}})
+        global ACTIVE
+        ACTIVE = self
 
     # -- producers (background/controller thread; never block) -------------
 
@@ -173,6 +209,29 @@ class Timeline:
                "ts": self._ts_us()}
         self._emit(rec)
 
+    def lifecycle(self, tensor_name: str, stage: str, begin: bool,
+                  cycle: Optional[int] = None) -> None:
+        """Cycle-tagged lifecycle span on the tensor's lane (``LC_*`` —
+        submitted/fuse/wire/reduce/callback; docs/observability.md lists
+        the schema).  Unlike :meth:`activity`, B records carry
+        ``args.cycle`` so ``tools/critical_path.py`` can group a tensor's
+        spans into per-step chains across ranks."""
+        rec = {"name": stage if begin else "", "ph": "B" if begin else "E",
+               "pid": self._pid, "tid": self._tid(tensor_name),
+               "ts": self._ts_us()}
+        if begin:
+            rec["args"] = {"cycle": self._cycle if cycle is None else cycle}
+        self._emit(rec)
+
+    def lifecycle_mark(self, tensor_name: str, stage: str,
+                       cycle: Optional[int] = None) -> None:
+        """Instant lifecycle marker (e.g. ``LC_NEGOTIATED`` with the cycle
+        the response was agreed in)."""
+        self._emit({"name": stage, "ph": "i", "s": "t", "pid": self._pid,
+                    "tid": self._tid(tensor_name), "ts": self._ts_us(),
+                    "args": {"cycle": self._cycle if cycle is None
+                             else cycle}})
+
     def mark_cycle(self) -> None:
         if self._mark_cycles:
             self._emit({"name": "CYCLE", "ph": "i", "s": "g",
@@ -195,6 +254,9 @@ class Timeline:
                 break
 
     def close(self) -> None:
+        global ACTIVE
+        if ACTIVE is self:
+            ACTIVE = None
         if self._closed:
             return
         self._closed = True

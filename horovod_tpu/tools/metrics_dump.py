@@ -12,6 +12,8 @@ Usage::
     python -m horovod_tpu.tools.metrics_dump --addr 10.0.0.2 --port 41999
     python -m horovod_tpu.tools.metrics_dump --raw        # Prometheus text
     tools/metrics_dump.py --json                          # raw snapshots
+    tools/metrics_dump.py --watch 2                       # re-scrape every 2s
+    tools/metrics_dump.py --watch 2 --rate                # per-second deltas
 
 Address defaults come from the launcher-propagated
 ``HOROVOD_GLOO_RENDEZVOUS_ADDR``/``PORT`` env, so running it on any job
@@ -23,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 import urllib.request
 from typing import Optional, Sequence
 
@@ -56,6 +59,56 @@ def _pretty(snaps: dict) -> str:
     return "\n".join(out)
 
 
+def _rates(prev: dict, cur: dict, dt: float) -> str:
+    """Per-second counter deltas between two snapshot scrapes (gauges are
+    levels, not rates — shown as their current value)."""
+    out = []
+    for key in sorted(cur, key=str):
+        snap = cur[key]
+        before = prev.get(key, {})
+        rank = snap.get("rank", key)
+        out.append(f"== rank {rank} (Δ over {dt:.1f}s) ==")
+        prev_c = before.get("counters", {})
+        for name in sorted(snap.get("counters", {})):
+            d = snap["counters"][name] - prev_c.get(name, 0)
+            if d:
+                out.append(f"  {name} = +{d / dt:.6g}/s")
+        for name in sorted(snap.get("gauges", {})):
+            out.append(f"  {name} = {snap['gauges'][name]} (gauge)")
+        prev_h = before.get("histograms", {})
+        for name in sorted(snap.get("histograms", {})):
+            h = snap["histograms"][name]
+            p = prev_h.get(name, {})
+            dc = h.get("count", 0) - p.get("count", 0)
+            if dc:
+                ds = h.get("sum", 0.0) - p.get("sum", 0.0)
+                out.append(f"  {name}: +{dc / dt:.6g} obs/s "
+                           f"mean={ds / dc:.6g}")
+    return "\n".join(out)
+
+
+def _render_once(addr: str, port: int, args,
+                 prev: Optional[dict], dt: float) -> Optional[dict]:
+    """One scrape + print; returns the parsed snapshots (None in raw
+    mode, where rates don't apply)."""
+    if args.raw:
+        print(fetch(addr, port, "text"), end="")
+        return None
+    if args.json:
+        text = fetch(addr, port, "json")
+        print(text)
+        return json.loads(text)
+    snaps = json.loads(fetch(addr, port, "json"))
+    if not snaps:
+        print("metrics-dump: no rank has pushed a snapshot yet "
+              "(HOROVOD_METRICS_PUSH_SECS=0, or the job just started)")
+    elif args.rate and prev is not None:
+        print(_rates(prev, snaps, dt))
+    else:
+        print(_pretty(snaps))
+    return snaps
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="metrics-dump",
@@ -71,7 +124,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="print the Prometheus text scrape verbatim")
     ap.add_argument("--json", action="store_true",
                     help="print the raw per-rank snapshot JSON")
+    ap.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="re-scrape every N seconds until interrupted")
+    ap.add_argument("--rate", action="store_true",
+                    help="with --watch: print per-second counter deltas "
+                         "between scrapes instead of absolute values")
     args = ap.parse_args(argv)
+    if args.rate and not args.watch:
+        ap.error("--rate requires --watch (rates need two scrapes)")
 
     addr = args.addr or env_mod.get_str(env_mod.HOROVOD_RENDEZVOUS_ADDR)
     port = args.port or env_mod.get_int(env_mod.HOROVOD_RENDEZVOUS_PORT, 0)
@@ -79,24 +139,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("metrics-dump: no rendezvous server (pass --addr/--port or "
               "run inside a job's environment)", file=sys.stderr)
         return 2
-    try:
-        if args.raw:
-            print(fetch(addr, port, "text"), end="")
-        elif args.json:
-            print(fetch(addr, port, "json"))
-        else:
-            snaps = json.loads(fetch(addr, port, "json"))
-            if not snaps:
-                print("metrics-dump: no rank has pushed a snapshot yet "
-                      "(HOROVOD_METRICS_PUSH_SECS=0, or the job just "
-                      "started)")
-            else:
-                print(_pretty(snaps))
-    except OSError as e:
-        print(f"metrics-dump: scrape of {addr}:{port} failed: {e}",
-              file=sys.stderr)
-        return 1
-    return 0
+    prev: Optional[dict] = None
+    t_prev = time.monotonic()
+    while True:
+        try:
+            now = time.monotonic()
+            prev = _render_once(addr, port, args, prev,
+                                max(now - t_prev, 1e-9))
+            t_prev = now
+        except OSError as e:
+            print(f"metrics-dump: scrape of {addr}:{port} failed: {e}",
+                  file=sys.stderr)
+            if not args.watch:
+                return 1
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+        print(f"---- {time.strftime('%H:%M:%S')} ----")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,195 @@
+"""Strict validator for the ``GET /metrics`` Prometheus text exposition.
+
+``ci/metrics_smoke.sh`` scrapes a live np=2 job and feeds the text here;
+the unit tests feed synthetic renders.  Checks, in the spirit of
+promtool's lint but stdlib-only:
+
+- every non-blank line parses as ``# HELP``/``# TYPE`` metadata or as a
+  sample (``name{labels} value``), labels well-formed, value a float;
+- a family's HELP and TYPE precede its first sample, TYPE is a known
+  kind, and neither repeats;
+- histograms are shape-complete per label set: ``le`` bucket bounds
+  strictly ascending, cumulative counts non-decreasing, a ``+Inf``
+  bucket present and equal to the matching ``_count`` sample;
+- catalog coverage, both ways: every scraped family must be a
+  ``CATALOG`` entry of the matching kind (a typo'd or unregistered
+  series fails the scrape), and every family in ``--required`` must be
+  present.  Full reverse coverage (every CATALOG entry scraped) is not a
+  property any single run can have — fault counters only exist in chaos
+  runs, driver gauges only on the elastic driver — so the smoke lane
+  pins the subset a clean np=2 job must always serve.
+
+Usage::
+
+    python -m horovod_tpu.tools.prom_validate scrape.txt \\
+        --required controller_cycles_total collective_latency_seconds
+    ... | python -m horovod_tpu.tools.prom_validate -
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.metrics import CATALOG, PROM_PREFIX
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(\S+)$")
+_META_RE = re.compile(
+    r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*)(?: (.*))?$")
+_LABEL_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="([^"\n\\]*)"$')
+_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_labels(block: Optional[str],
+                  errs: List[str], where: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    if not block:
+        return labels
+    for item in block[1:-1].split(","):
+        if not item:
+            continue
+        m = _LABEL_RE.match(item)
+        if not m:
+            errs.append(f"{where}: malformed label {item!r}")
+            continue
+        labels[m.group(1)] = m.group(2)
+    return labels
+
+
+def _family_of(name: str, types: Dict[str, str]) -> str:
+    """Resolve a sample name to its metric family: histogram samples
+    ``X_bucket``/``X_sum``/``X_count`` belong to family ``X``."""
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[:-len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return name
+
+
+def validate(text: str, required: Sequence[str] = (),
+             prefix: str = PROM_PREFIX) -> List[str]:
+    """Return the list of violations (empty == valid)."""
+    errs: List[str] = []
+    helps: Dict[str, str] = {}
+    types: Dict[str, str] = {}
+    sampled: List[str] = []  # families in first-sample order
+    # (family, labels-minus-le) -> [(le_float, cum_count)]
+    buckets: Dict[Tuple, List[Tuple[float, float]]] = {}
+    counts: Dict[Tuple, float] = {}
+
+    for ln_no, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        where = f"line {ln_no}"
+        m = _META_RE.match(line)
+        if m:
+            what, name, rest = m.groups()
+            table = helps if what == "HELP" else types
+            if name in table:
+                errs.append(f"{where}: duplicate # {what} for {name}")
+            table[name] = rest or ""
+            if what == "TYPE" and rest not in _KINDS:
+                errs.append(f"{where}: unknown TYPE {rest!r} for {name}")
+            continue
+        if line.startswith("#"):
+            errs.append(f"{where}: unparseable comment {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errs.append(f"{where}: unparseable sample {line!r}")
+            continue
+        name, label_block, value_s = m.groups()
+        labels = _parse_labels(label_block, errs, where)
+        try:
+            value = float(value_s)
+        except ValueError:
+            errs.append(f"{where}: non-numeric value {value_s!r}")
+            continue
+        family = _family_of(name, types)
+        if family not in types:
+            errs.append(f"{where}: sample {name} before its # TYPE")
+        if family not in helps:
+            errs.append(f"{where}: sample {name} before its # HELP")
+        if family not in sampled:
+            sampled.append(family)
+        if types.get(family) == "histogram":
+            key = (family, tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le")))
+            if name.endswith("_bucket"):
+                le_s = labels.get("le")
+                if le_s is None:
+                    errs.append(f"{where}: histogram bucket without le=")
+                    continue
+                le = float("inf") if le_s == "+Inf" else float(le_s)
+                buckets.setdefault(key, []).append((le, value))
+            elif name.endswith("_count"):
+                counts[key] = value
+
+    for (family, lbls), series in buckets.items():
+        where = f"{family}{dict(lbls) if lbls else ''}"
+        les = [le for le, _ in series]
+        if les != sorted(les) or len(set(les)) != len(les):
+            errs.append(f"{where}: le bounds not strictly ascending")
+        vals = [v for _, v in series]
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            errs.append(f"{where}: bucket counts not cumulative")
+        if not les or les[-1] != float("inf"):
+            errs.append(f"{where}: missing +Inf bucket")
+        elif (family, lbls) in counts and vals[-1] != counts[(family, lbls)]:
+            errs.append(f"{where}: +Inf bucket {vals[-1]} != _count "
+                        f"{counts[(family, lbls)]}")
+        if (family, lbls) not in counts:
+            errs.append(f"{where}: histogram without a _count sample")
+
+    for family in sampled:
+        if not family.startswith(prefix):
+            errs.append(f"family {family} lacks the {prefix} prefix")
+            continue
+        base = family[len(prefix):]
+        entry = CATALOG.get(base)
+        if entry is None:
+            errs.append(f"family {family}: {base!r} not in CATALOG "
+                        "(HVD007: every scraped series must be declared)")
+        elif types.get(family) != entry[0]:
+            errs.append(f"family {family}: TYPE {types.get(family)!r} != "
+                        f"catalog kind {entry[0]!r}")
+
+    present = {f[len(prefix):] for f in sampled if f.startswith(prefix)}
+    for base in required:
+        if base not in present:
+            errs.append(f"required family {base} missing from the scrape")
+    return errs
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="prom-validate",
+        description="strictly validate a /metrics Prometheus text scrape "
+                    "against the metric catalog")
+    ap.add_argument("input", help="scrape file, or - for stdin")
+    ap.add_argument("--required", nargs="*", default=[],
+                    help="catalog families that must be present")
+    args = ap.parse_args(argv)
+    text = sys.stdin.read() if args.input == "-" \
+        else open(args.input).read()
+    errs = validate(text, required=args.required)
+    for e in errs:
+        print(f"prom-validate: {e}", file=sys.stderr)
+    n_fam = len({ln.split("{")[0].split()[0] for ln in text.splitlines()
+                 if ln and not ln.startswith("#")})
+    if errs:
+        print(f"prom-validate: FAILED ({len(errs)} violation(s) across "
+              f"{n_fam} series name(s))", file=sys.stderr)
+        return 1
+    print(f"prom-validate: OK ({n_fam} series name(s), "
+          f"{len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -299,7 +299,8 @@ def test_corrupt_abort_writes_flight_recorder_dump_on_every_rank(tmp_path):
                        "tcp.send:rank=1:nth=6:action=corrupt,1"})
     for r in range(2):
         assert f"SURVIVOR_ABORT {r}" in outs[r], (r, outs[r])
-        dump = tmp_path / f"hvd_flight_recorder.rank{r}.json"
+        dump = tmp_path / "hvd_flight_recorder" \
+            / f"hvd_flight_recorder.rank{r}.json"
         assert dump.exists(), (r, outs[r])
         doc = json.loads(dump.read_text())  # parseable on every rank
         assert doc["rank"] == r
@@ -310,10 +311,11 @@ def test_corrupt_abort_writes_flight_recorder_dump_on_every_rank(tmp_path):
         assert doc["metrics"] and "counters" in doc["metrics"]
     # the detector's dump names the CRC failure; the injector's ring
     # recorded its own fired fault clause
-    doc0 = json.loads((tmp_path / "hvd_flight_recorder.rank0.json")
+    dump_dir = tmp_path / "hvd_flight_recorder"
+    doc0 = json.loads((dump_dir / "hvd_flight_recorder.rank0.json")
                       .read_text())
     assert "wire CRC" in doc0["reason"] or "FrameCorrupt" in doc0["reason"]
-    doc1 = json.loads((tmp_path / "hvd_flight_recorder.rank1.json")
+    doc1 = json.loads((dump_dir / "hvd_flight_recorder.rank1.json")
                       .read_text())
     assert "fault" in {e["kind"] for e in doc1["events"]}, doc1["events"]
 
@@ -363,6 +365,94 @@ def test_truncated_frame_np2_typed_abort():
     for r in range(2):
         assert f"SURVIVOR_ABORT {r}" in outs[r], (r, outs[r])
         assert "struct.error" not in outs[r], (r, outs[r])
+
+
+# ---------------------------------------------------------------------------
+# performance attribution plane (docs/observability.md): straggler
+# detector + lifecycle trace + critical-path report, one np=3 run
+# ---------------------------------------------------------------------------
+
+
+_STRAGGLER_BODY = """
+from horovod_tpu.core import flight_recorder, metrics
+
+gauge_named_rank1 = 0
+for i in range(24):
+    # DISTINCT names every round: cache misses keep the negotiation on
+    # the table path, so the coordinator emits NEGOTIATE spans with
+    # per-rank readiness instants (critical_path's attribution input).
+    hvd.allreduce(np.ones(4096, np.float32), name=f"cp{i}")
+    if rank == 0 and metrics.registry.get_gauge("straggler_suspect") == 1:
+        gauge_named_rank1 += 1
+hvd.barrier()
+if rank == 0:
+    flags = metrics.registry.get_counter("straggler_flags_total", rank="1")
+    assert flags >= 1, f"rank 1 never flagged (flags={flags})"
+    for r in (0, 2):
+        assert metrics.registry.get_counter(
+            "straggler_flags_total", rank=str(r)) == 0, r
+    assert gauge_named_rank1 > 0, "straggler_suspect gauge never hit 1"
+    stragglers = [e for e in flight_recorder.recorder.events()
+                  if e["kind"] == "straggler"]
+    assert stragglers, "no straggler event in the coordinator's ring"
+    assert all(e["rank"] == 1 for e in stragglers), stragglers
+    path = flight_recorder.recorder.dump("straggler-proof")
+    assert path, "flight-recorder dump failed"
+    print("STRAGGLER_OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(360)
+def test_straggler_attribution_np3_all_surfaces_agree(tmp_path):
+    """Headline acceptance: ONE np=3 run with an injected 60 ms delay on
+    every rank-1 collective submission (the ``enqueue.collective`` site),
+    run under lockdep, must make all three attribution surfaces agree:
+
+    - the online detector flags rank 1 (``straggler_flags_total`` +
+      ``straggler_suspect`` gauge observed naming rank 1, never 0 or 2),
+    - the coordinator's flight-recorder dump carries ``straggler`` events
+      for rank 1,
+    - the merged 3-rank timeline's critical-path report attributes the
+      inflated step time to rank 1's negotiation-wait phase."""
+    from horovod_tpu.tools import critical_path, trace_merge
+
+    tl = tmp_path / "tl.json"
+    outs = run_distributed(
+        3, _STRAGGLER_BODY, timeout=300,
+        extra_env={
+            "HOROVOD_FAULT_SPEC":
+                "enqueue.collective:rank=1:action=delay_ms,60",
+            "HOROVOD_STRAGGLER_THRESHOLD_SECS": "0.015",
+            "HOROVOD_STRAGGLER_EWMA_ALPHA": "0.6",
+            "HOROVOD_TIMELINE": str(tl),
+            "HOROVOD_FLIGHT_RECORDER_DIR": str(tmp_path),
+            "HOROVOD_LOCK_DEBUG": "1",
+        })
+    assert "STRAGGLER_OK" in outs[0], outs[0]
+
+    # surface 2: the dump artifact (hvd_flight_recorder/ subdir) parses
+    # and names rank 1
+    dump = tmp_path / "hvd_flight_recorder" / "hvd_flight_recorder.rank0.json"
+    assert dump.exists()
+    doc = json.loads(dump.read_text())
+    events = [e for e in doc["events"] if e["kind"] == "straggler"]
+    assert events and all(e["rank"] == 1 for e in events), doc["events"]
+
+    # surface 3: hvd-critical-path over the merged trace pins the
+    # inflation on rank 1's negotiation wait
+    traces = [trace_merge.load_trace(
+        str(tl) if r == 0 else f"{tl}.rank{r}") for r in range(3)]
+    report = critical_path.analyze(trace_merge.merge(traces))
+    waits = {r: report["totals_us"].get(str(r), {})
+             .get("negotiation_wait", 0.0) for r in range(3)}
+    # 24 rounds x 60 ms injected: rank 1 owes most of a second of
+    # negotiation wait; the healthy ranks only scheduling jitter.
+    assert waits[1] > 500e3, waits
+    assert waits[1] > 5 * max(waits[0], waits[2]), waits
+    dominated = [s for s in report["steps"]
+                 if s["dominant"]["rank"] == 1
+                 and s["dominant"]["phase"] == "negotiation_wait"]
+    assert dominated, report["steps"][:3]
 
 
 _KILL_MID_SAVE_BODY = """

@@ -185,6 +185,32 @@ class TestPrometheusRender:
                 if line.startswith("hvd_collective_latency_seconds_bucket")]
         assert vals == sorted(vals)
 
+    def test_three_rank_histogram_merge_is_exact(self):
+        """Scrape-time merge exactness at np=3: the rendered cumulative
+        distribution must equal the element-wise sum of the three ranks'
+        bucket arrays — no drops, no double counts, any rank count."""
+        n = len(metrics.BUCKET_BOUNDS) + 1
+        per_rank = []
+        for r in range(3):
+            counts = [0] * n
+            counts[r] = r + 1          # distinct bucket per rank
+            counts[-1] = r             # plus overflow traffic on ranks 1-2
+            per_rank.append(counts)
+        snaps = {r: _snap(r, histograms={"collective_latency_seconds": {
+            "counts": c, "sum": float(r), "count": sum(c)}})
+            for r, c in enumerate(per_rank)}
+        text = metrics.render_prometheus(snaps)
+        merged = [sum(c[i] for c in per_rank) for i in range(n)]
+        cumulative, acc = [], 0
+        for v in merged:
+            acc += v
+            cumulative.append(acc)
+        got = [float(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+               if line.startswith("hvd_collective_latency_seconds_bucket")]
+        assert got == cumulative
+        assert f"hvd_collective_latency_seconds_count {acc}" in text
+        assert "hvd_collective_latency_seconds_sum 3" in text
+
     def test_malformed_snapshot_is_skipped(self):
         text = metrics.render_prometheus({
             0: _snap(0, counters={"aborts_total": 1}), 1: "garbage"})
@@ -255,7 +281,8 @@ class TestFlightRecorder:
         monkeypatch.setenv("HOROVOD_RANK", "7")
         flight_recorder.record("cycle", n=1)
         path = flight_recorder.recorder.dump("dir knob")
-        assert path == str(tmp_path / "hvd_flight_recorder.rank7.json")
+        assert path == str(tmp_path / "hvd_flight_recorder"
+                           / "hvd_flight_recorder.rank7.json")
         assert json.loads(open(path).read())["rank"] == 7
 
     def test_disabled_records_and_dumps_nothing(self, tmp_path,
@@ -325,6 +352,121 @@ class TestStallMetrics:
 
 
 # ---------------------------------------------------------------------------
+# online straggler detection (coordinator-side EWMAs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestStragglerDetector:
+    def _controller(self, thresh=0.05, alpha=0.5, size=3):
+        from horovod_tpu.common.topology import ProcessTopology
+        from horovod_tpu.core.controller import Controller
+
+        topo = ProcessTopology(rank=0, size=size, local_rank=0,
+                               local_size=size)
+        c = Controller(topo, mesh=None)
+        c.straggler_threshold = thresh
+        c.straggler_alpha = alpha
+        return c
+
+    def _lagging_entry(self, c, name="lag", ranks=(0, 2), age=1.0):
+        from horovod_tpu.core.controller import _TableEntry
+
+        entry = _TableEntry()
+        entry.ranks.update(ranks)
+        entry.majority_seen = time.monotonic() - age
+        c._message_table[name] = entry
+
+    def test_clean_state_early_outs(self):
+        # Steady state (no majority stamps, no decaying EWMA) must not
+        # even touch the EWMA dict — the hot path's two falsy checks.
+        c = self._controller()
+        c._update_stragglers()
+        assert c._straggler_ewma == {}
+        assert metrics.registry.get_gauge("straggler_suspect") is None
+
+    def test_lag_flags_the_missing_rank(self):
+        c = self._controller(thresh=0.05, alpha=0.5)
+        self._lagging_entry(c, ranks=(0, 2), age=1.0)
+        c._update_stragglers()
+        # one EWMA step: 0 + 0.5 * (1.0s - 0) — only the missing rank lags
+        assert c._straggler_ewma[1] == pytest.approx(0.5, rel=0.05)
+        assert c._straggler_ewma[0] == 0.0
+        assert c._straggler_ewma[2] == 0.0
+        assert c._straggler_suspects == {1}
+        assert metrics.registry.get_counter(
+            "straggler_flags_total", rank="1") == 1
+        assert metrics.registry.get_gauge("straggler_suspect") == 1
+        key = metrics.flat("straggler_lag_seconds", rank="1")
+        assert metrics.registry.snapshot()["histograms"][key]["count"] == 1
+        flagged = [e for e in flight_recorder.recorder.events()
+                   if e["kind"] == "straggler"]
+        assert len(flagged) == 1 and flagged[0]["rank"] == 1
+
+    def test_hysteresis_clears_at_half_threshold(self):
+        c = self._controller(thresh=0.05, alpha=0.5)
+        self._lagging_entry(c, age=1.0)
+        c._update_stragglers()
+        assert c._straggler_suspects == {1}
+        c._message_table.clear()
+        # decay: lag 0 every cycle, EWMA halves; the suspect must clear
+        # only once it falls below thresh/2, and exactly once.
+        for _ in range(50):
+            c._update_stragglers()
+            if not c._straggler_suspects:
+                break
+        assert not c._straggler_suspects
+        assert c._straggler_ewma[1] < c.straggler_threshold / 2
+        assert metrics.registry.get_gauge("straggler_suspect") == -1
+        assert metrics.registry.get_counter(
+            "straggler_flags_total", rank="1") == 1  # one episode, one flag
+        kinds = [e["kind"] for e in flight_recorder.recorder.events()]
+        assert kinds.count("straggler_cleared") == 1
+
+    def test_mask_bit_majority_path_attributes_lag(self):
+        # The cache fast path has no table entries: lag comes from
+        # announced-bit majority stamps vs per-rank pending masks.
+        c = self._controller(thresh=10.0, alpha=1.0)
+        c._mask_bit_majority[3] = time.monotonic() - 0.5
+        c._pending_masks = {0: 1 << 3, 2: 1 << 3}  # rank 1 silent on bit 3
+        c._update_stragglers()
+        assert c._straggler_ewma[1] == pytest.approx(0.5, rel=0.05)
+        assert c._straggler_ewma[0] == 0.0
+        assert c._straggler_ewma[2] == 0.0
+
+    def test_joined_rank_is_not_blamed(self):
+        c = self._controller(thresh=0.05, alpha=1.0)
+        c._joined_ranks.add(1)
+        self._lagging_entry(c, ranks=(0, 2), age=1.0)
+        c._update_stragglers()
+        assert c._straggler_ewma.get(1, 0.0) == 0.0
+        assert not c._straggler_suspects
+
+    def test_zero_threshold_disables_flagging_not_tracking(self):
+        c = self._controller(thresh=0.0, alpha=1.0)
+        self._lagging_entry(c, age=1.0)
+        c._update_stragglers()
+        assert c._straggler_ewma[1] > 0.9  # EWMA still tracks
+        assert not c._straggler_suspects   # but nothing flags
+        assert metrics.registry.get_gauge("straggler_suspect") is None
+
+    def test_alpha_validation(self, monkeypatch):
+        from horovod_tpu.common import env as env_mod
+
+        monkeypatch.setenv(env_mod.HOROVOD_STRAGGLER_EWMA_ALPHA, "0")
+        with pytest.raises(ValueError, match="STRAGGLER_EWMA_ALPHA"):
+            self._controller()
+
+    def test_stall_suffix_names_worst_laggard(self):
+        c = self._controller()
+        c._straggler_ewma = {1: 0.4, 2: 0.1}
+        suffix = c._lag_suffix([1, 2])
+        assert "rank 1" in suffix and "0.400" in suffix
+        # a missing rank with no observed lag yields no accusation
+        assert c._lag_suffix([0]) == ""
+
+
+# ---------------------------------------------------------------------------
 # trace merge
 # ---------------------------------------------------------------------------
 
@@ -390,6 +532,235 @@ class TestTraceMerge:
         assert rc == 0
         merged = json.loads(out.read_text())
         assert {e.get("pid") for e in merged if e.get("ph") == "B"} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# critical-path extraction
+# ---------------------------------------------------------------------------
+
+
+def _cp_ev(name, ph, pid, tid, ts, **args):
+    e = {"name": name, "ph": ph, "pid": pid, "tid": tid, "ts": ts}
+    if args:
+        e["args"] = args
+    return e
+
+
+def _cp_step_events():
+    """One negotiation cycle (7), three ranks: rank 1 announced 80 us
+    after the span opened (everyone waited for it), rank 0 shows a full
+    fuse/wire/reduce breakdown, rank 1's op span ends the step."""
+    return [
+        # coordinator negotiation lane (pid 0) with readiness instants
+        _cp_ev("NEGOTIATE_ALLREDUCE", "B", 0, 9, 100, cycle=7),
+        _cp_ev("0", "i", 0, 9, 110),
+        _cp_ev("2", "i", 0, 9, 120),
+        _cp_ev("1", "i", 0, 9, 180),
+        _cp_ev("NEGOTIATE_ALLREDUCE", "E", 0, 9, 185),
+        # rank 0 tensor lane: op span with nested lifecycle phases
+        _cp_ev("ALLREDUCE", "B", 0, 1, 200, cycle=7),
+        _cp_ev("LC_FUSE", "B", 0, 1, 200),           # inherits cycle 7
+        _cp_ev("LC_FUSE", "E", 0, 1, 210),
+        _cp_ev("LC_WIRE_REDUCE_SCATTER", "B", 0, 1, 215),
+        _cp_ev("LC_WIRE_REDUCE_SCATTER", "E", 0, 1, 245),
+        _cp_ev("LC_WIRE_ALLGATHER", "B", 0, 1, 245),
+        _cp_ev("LC_WIRE_ALLGATHER", "E", 0, 1, 275),
+        _cp_ev("ALLREDUCE", "E", 0, 1, 300),
+        # ranks 1 and 2: bare op spans; rank 1 ends last
+        _cp_ev("ALLREDUCE", "B", 1, 1, 150, cycle=7),
+        _cp_ev("ALLREDUCE", "E", 1, 1, 320),
+        _cp_ev("ALLREDUCE", "B", 2, 1, 150, cycle=7),
+        _cp_ev("ALLREDUCE", "E", 2, 1, 260),
+    ]
+
+
+@pytest.mark.smoke
+class TestCriticalPath:
+    def test_step_attribution(self):
+        from horovod_tpu.tools import critical_path
+
+        doc = critical_path.analyze(_cp_step_events())
+        assert doc["format"] == "hvd-critical-path-v1"
+        assert doc["ranks_seen"] == [0, 1, 2]
+        (step,) = doc["steps"]
+        assert step["cycle"] == 7
+        assert step["duration_us"] == 220.0        # 100 .. 320
+        assert step["critical_rank"] == 1
+        assert doc["critical_step_counts"] == {"1": 1}
+        p0 = step["phases_us"]["0"]
+        # negotiation wait goes to the LAST-ready rank (1), not pid 0
+        assert "negotiation_wait" not in step["phases_us"].get("0", {}) \
+            or p0["negotiation_wait"] == 0.0
+        assert step["phases_us"]["1"]["negotiation_wait"] == 80.0
+        assert p0["fusion"] == 10.0
+        assert p0["reduce"] == 30.0
+        assert p0["wire"] == 30.0
+        # dispatch = op span minus the attributed sub-phases
+        assert p0["dispatch"] == 100.0 - 70.0
+        assert step["phases_us"]["2"]["dispatch"] == 110.0
+
+    def test_fused_batch_counts_wire_once(self):
+        from horovod_tpu.tools import critical_path
+
+        # A fused batch emits the same wire span on every member tensor's
+        # lane: attribution must union, not sum.
+        events = [
+            _cp_ev("LC_WIRE_ALLGATHER", "B", 0, 1, 10, cycle=1),
+            _cp_ev("LC_WIRE_ALLGATHER", "E", 0, 1, 30),
+            _cp_ev("LC_WIRE_ALLGATHER", "B", 0, 2, 10, cycle=1),
+            _cp_ev("LC_WIRE_ALLGATHER", "E", 0, 2, 30),
+        ]
+        doc = critical_path.analyze(events)
+        assert doc["totals_us"]["0"]["wire"] == 20.0
+
+    def test_unclosed_span_closes_at_lane_end(self):
+        from horovod_tpu.tools import critical_path
+
+        events = [
+            _cp_ev("ALLREDUCE", "B", 0, 1, 10, cycle=1),
+            _cp_ev("LC_FUSE", "B", 0, 1, 20),
+            _cp_ev("LC_FUSE", "E", 0, 1, 40),   # lane's last ts
+        ]
+        spans = critical_path.reconstruct(events)
+        op = next(s for s in spans if s.name == "ALLREDUCE")
+        assert op.e == 40
+        assert all(s.cycle == 1 for s in spans)  # nested inheritance
+
+    def test_cli_writes_json_report(self, tmp_path, capsys):
+        from horovod_tpu.tools import critical_path
+
+        trace = tmp_path / "tl.json"
+        trace.write_text(json.dumps(_cp_step_events()))
+        out = tmp_path / "cp.json"
+        rc = critical_path.main([str(trace), "--json", str(out), "--top", "3"])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["steps"][0]["critical_rank"] == 1
+        text = capsys.readouterr().out
+        assert "critical rank by step count: rank 1" in text
+
+    def test_no_cycles_degrades_gracefully(self):
+        from horovod_tpu.tools import critical_path
+
+        doc = critical_path.analyze([_cp_ev("X", "B", 0, 1, 5),
+                                     _cp_ev("X", "E", 0, 1, 9)])
+        assert doc["steps"] == []
+        assert "HOROVOD_TIMELINE" in critical_path.render_text(doc)
+
+
+# ---------------------------------------------------------------------------
+# prometheus text validator (the metrics-smoke lane's checker)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestPromValidate:
+    def test_real_render_is_valid(self):
+        from horovod_tpu.tools import prom_validate
+
+        counts = [0] * (len(metrics.BUCKET_BOUNDS) + 1)
+        counts[2] = 1
+        text = metrics.render_prometheus({
+            0: _snap(0, counters={"aborts_total": 2},
+                     gauges={"tensor_queue_depth": 1},
+                     histograms={"collective_latency_seconds": {
+                         "counts": counts, "sum": 0.5, "count": 1}}),
+            1: _snap(1, gauges={"straggler_suspect": -1})})
+        assert prom_validate.validate(text) == []
+
+    def test_required_family_enforced(self):
+        from horovod_tpu.tools import prom_validate
+
+        text = metrics.render_prometheus(
+            {0: _snap(0, counters={"aborts_total": 1})})
+        errs = prom_validate.validate(
+            text, required=["straggler_flags_total"])
+        assert any("straggler_flags_total" in e and "missing" in e
+                   for e in errs)
+
+    def test_uncataloged_family_rejected(self):
+        from horovod_tpu.tools import prom_validate
+
+        text = ("# HELP hvd_bogus_total x\n"
+                "# TYPE hvd_bogus_total counter\n"
+                "hvd_bogus_total 1\n")
+        errs = prom_validate.validate(text)
+        assert any("not in CATALOG" in e for e in errs)
+
+    def test_sample_before_metadata_rejected(self):
+        from horovod_tpu.tools import prom_validate
+
+        errs = prom_validate.validate("hvd_aborts_total 1\n")
+        assert any("before its # TYPE" in e for e in errs)
+        assert any("before its # HELP" in e for e in errs)
+
+    def test_non_cumulative_buckets_rejected(self):
+        from horovod_tpu.tools import prom_validate
+
+        text = (
+            "# HELP hvd_collective_latency_seconds x\n"
+            "# TYPE hvd_collective_latency_seconds histogram\n"
+            'hvd_collective_latency_seconds_bucket{le="0.1"} 3\n'
+            'hvd_collective_latency_seconds_bucket{le="+Inf"} 2\n'
+            "hvd_collective_latency_seconds_sum 1\n"
+            "hvd_collective_latency_seconds_count 2\n")
+        errs = prom_validate.validate(text)
+        assert any("not cumulative" in e for e in errs)
+
+    def test_inf_bucket_must_equal_count(self):
+        from horovod_tpu.tools import prom_validate
+
+        text = (
+            "# HELP hvd_collective_latency_seconds x\n"
+            "# TYPE hvd_collective_latency_seconds histogram\n"
+            'hvd_collective_latency_seconds_bucket{le="+Inf"} 5\n'
+            "hvd_collective_latency_seconds_sum 1\n"
+            "hvd_collective_latency_seconds_count 4\n")
+        errs = prom_validate.validate(text)
+        assert any("+Inf bucket" in e and "_count" in e for e in errs)
+
+    def test_kind_mismatch_rejected(self):
+        from horovod_tpu.tools import prom_validate
+
+        text = ("# HELP hvd_aborts_total x\n"
+                "# TYPE hvd_aborts_total gauge\n"
+                "hvd_aborts_total 1\n")
+        errs = prom_validate.validate(text)
+        assert any("catalog kind" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# metrics-dump --watch/--rate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestMetricsDumpWatch:
+    def test_rates_are_per_second_deltas(self):
+        from horovod_tpu.tools import metrics_dump
+
+        prev = {"0": {"rank": 0, "counters": {"x_total": 10},
+                      "histograms": {"h": {"count": 2, "sum": 1.0}}}}
+        cur = {"0": {"rank": 0, "counters": {"x_total": 30},
+                     "gauges": {"depth": 5},
+                     "histograms": {"h": {"count": 6, "sum": 3.0}}}}
+        out = metrics_dump._rates(prev, cur, 2.0)
+        assert "x_total = +10/s" in out       # (30-10)/2s
+        assert "depth = 5 (gauge)" in out     # gauges are levels
+        assert "+2 obs/s" in out and "mean=0.5" in out
+
+    def test_unchanged_counters_are_omitted(self):
+        from horovod_tpu.tools import metrics_dump
+
+        snap = {"0": {"rank": 0, "counters": {"x_total": 10}}}
+        out = metrics_dump._rates(snap, snap, 1.0)
+        assert "x_total" not in out
+
+    def test_rate_requires_watch(self):
+        from horovod_tpu.tools import metrics_dump
+
+        with pytest.raises(SystemExit):
+            metrics_dump.main(["--rate"])
 
 
 # ---------------------------------------------------------------------------
