@@ -142,6 +142,12 @@ def main() -> int:
                         "per-variant step time + speedup vs uncompressed "
                         "(canonical artifact: "
                         "benchmarks/results/ring_compression_r9.json)")
+    p.add_argument("--transport-sweep", action="store_true",
+                   help="sweep HOROVOD_TRANSPORT shm/tcp/auto "
+                        "(interleaved) per config and report per-variant "
+                        "step time + shm speedup over loopback TCP "
+                        "(canonical artifact: "
+                        "benchmarks/results/ring_transport_sweep_r11.json)")
     p.add_argument("--out", type=str, default=None,
                    help="write result records to this JSON file")
     args = p.parse_args()
@@ -225,6 +231,28 @@ def main() -> int:
                     })
                     results.append(rec)
                     print(json.dumps(rec), flush=True)
+    elif args.transport_sweep:
+        for nbytes in args.sizes:
+            for np_ in args.world_sizes:
+                variants = [("shm", {"HOROVOD_TRANSPORT": "shm"}),
+                            ("tcp", {"HOROVOD_TRANSPORT": "tcp"}),
+                            ("auto", {"HOROVOD_TRANSPORT": "auto"})]
+                medians, samples = _interleaved_medians(
+                    variants, args.repeats, nbytes, np_, args.rounds)
+                rec = _record(nbytes, np_, medians["shm"])
+                rec.update({
+                    "metric": "ring_transport_sweep",
+                    "step_ms_shm": round(medians["shm"] * 1e3, 3),
+                    "step_ms_tcp": round(medians["tcp"] * 1e3, 3),
+                    "step_ms_auto": round(medians["auto"] * 1e3, 3),
+                    "shm_speedup_vs_tcp": round(
+                        medians["tcp"] / medians["shm"], 3),
+                    "samples_ms": {k: [round(s * 1e3, 3) for s in v]
+                                   for k, v in samples.items()},
+                    "repeats": args.repeats,
+                })
+                results.append(rec)
+                print(json.dumps(rec), flush=True)
     elif args.crc_sweep:
         for nbytes in args.sizes:
             for np_ in args.world_sizes:

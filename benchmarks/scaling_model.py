@@ -66,14 +66,27 @@ def _load_json(name):
 
 def project(step_ms: float, grad_bytes: int, n: int, busbw_gbs: float,
             cycle_ms: float, dispatch_ms: float,
-            wfbp_overhead_ms: float, compression_factor: float = 1.0)\
-        -> dict:
+            wfbp_overhead_ms: float, compression_factor: float = 1.0,
+            local_size: int = 1, intra_busbw_gbs: float = 0.0) -> dict:
     # Cast-on-the-wire compression (docs/data_plane.md) divides the bytes
     # crossing the wire — fp16/bf16 on f32 grads is factor 2 — while the
     # cast itself runs at memory bandwidth, far above wire busbw, so the
     # model folds it entirely into t_comm.
     wire_bytes = grad_bytes / compression_factor
-    t_comm = 2 * (n - 1) / n * wire_bytes / (busbw_gbs * 1e9) * 1e3  # ms
+    if local_size > 1 and n % local_size == 0 and n > local_size:
+        # Hierarchical cut (docs/data_plane.md "Transports"): the
+        # intra-host phase rides shm at intra_busbw (reduce-scatter +
+        # allgather over the full payload inside each host), the
+        # cross-host phase moves only 1/local_size of the payload per
+        # chip over the inter-host fabric.
+        hosts = n // local_size
+        t_intra = (2 * (local_size - 1) / local_size * wire_bytes
+                   / (intra_busbw_gbs * 1e9) * 1e3)
+        t_cross = (2 * (hosts - 1) / hosts * (wire_bytes / local_size)
+                   / (busbw_gbs * 1e9) * 1e3)
+        t_comm = t_intra + t_cross
+    else:
+        t_comm = 2 * (n - 1) / n * wire_bytes / (busbw_gbs * 1e9) * 1e3
     backward_ms = step_ms * 2 / 3
     jit_exposed = max(0.0, t_comm - backward_ms)
     # dispatch_ms (measured probe) already contains one full negotiation
@@ -101,10 +114,24 @@ def main() -> int:
     p.add_argument("--compression-factor", type=float, default=1.0,
                    help="wire-byte divisor from HOROVOD_WIRE_COMPRESSION "
                         "(2.0 for fp16/bf16 on f32 grads, 1.0 = raw)")
+    p.add_argument("--local-size", type=int, default=1,
+                   help="chips per host: >1 switches to the hierarchical "
+                        "cut — intra-host phase at --intra-busbw-gbs "
+                        "(shm data plane), cross-host bytes divided by "
+                        "local size")
+    p.add_argument("--intra-busbw-gbs", type=float, default=400.0,
+                   help="effective intra-host allreduce busbw for the "
+                        "shm transport (memory-bandwidth bound; see "
+                        "benchmarks/results/ring_transport_sweep_r11."
+                        "json for this box's measured shm-vs-tcp ratio)")
     p.add_argument("--out", default=None)
     args = p.parse_args()
     if args.compression_factor <= 0:
         p.error("--compression-factor must be positive")
+    if args.local_size < 1:
+        p.error("--local-size must be >= 1")
+    if args.intra_busbw_gbs <= 0:
+        p.error("--intra-busbw-gbs must be positive")
 
     # hot-path coordinator cycle p50 from the committed simulation
     # (benchmarks/results/controller_sim.json), by N
@@ -156,6 +183,9 @@ def main() -> int:
         "assumptions": {
             "busbw_gbs": args.busbw_gbs,
             "compression_factor": args.compression_factor,
+            "local_size": args.local_size,
+            "intra_busbw_gbs": (args.intra_busbw_gbs
+                                if args.local_size > 1 else None),
             "overlap_window": "2/3 of step (backward) for the jit and "
                               "eager-WFBP planes; none for the "
                               "post-backward eager plane",
@@ -171,7 +201,8 @@ def main() -> int:
         out["projections"][name] = [
             project(step_ms, grad_bytes, n, args.busbw_gbs,
                     cycle.get(n, 2.0), dispatch_ms, wfbp_ms,
-                    args.compression_factor)
+                    args.compression_factor, args.local_size,
+                    args.intra_busbw_gbs)
             for n in args.chips
         ]
     line = json.dumps(out, indent=1)

@@ -8,10 +8,14 @@
 #      once before failing the lane);
 #   2. injected slowdown — a delay_ms fault on rank 1's collective
 #      submission (the enqueue.collective site, docs/fault_injection.md)
-#      must come back "regression".
+#      must come back "regression";
+#   3. shm transport win — HOROVOD_TRANSPORT=auto (shm intra-host data
+#      plane, docs/data_plane.md "Transports") vs forced tcp on the same
+#      intra-host 4 MiB np=2 step must come back "improvement".
 #
-# Artifacts land in benchmarks/results/ab_aa_gate.json and
-# benchmarks/results/ab_rank1_delay_gate.json.
+# Artifacts land in benchmarks/results/ab_aa_gate.json,
+# benchmarks/results/ab_rank1_delay_gate.json and
+# benchmarks/results/ab_shm_gate.json.
 #
 #   sh ci/bench_gate.sh
 set -eu
@@ -64,5 +68,9 @@ run_case aa-null "no significant difference" \
 run_case rank1-delay regression \
     benchmarks/results/ab_rank1_delay_gate.json \
     --candidate "HOROVOD_FAULT_SPEC=$DELAY_SPEC" || rc=$?
+run_case shm-transport improvement \
+    benchmarks/results/ab_shm_gate.json \
+    --control "HOROVOD_TRANSPORT=tcp" \
+    --candidate "HOROVOD_TRANSPORT=auto" || rc=$?
 [ "$rc" -eq 0 ] || { echo "bench gate FAILED (rc=$rc)"; exit "$rc"; }
 echo "bench gate PASSED"

@@ -268,9 +268,15 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
     sn, rn = int(send_arr.size), int(recv_arr.size)
     n_send = -(-sn // seg)
     n_recv = -(-rn // seg)
-    deferred = mesh.deferred_digests
-    send_dig = mesh.new_digest() if deferred and n_send else None
-    recv_dig = mesh.new_digest() if deferred and n_recv else None
+    # Deferred-ness is a PER-LINK question (transport/select.py): under a
+    # mixed mesh the send direction may ride shm (CRC default off, no
+    # digests) while the recv direction rides TCP (shadow digests on) —
+    # each direction frames by its own link's answer, and both endpoints
+    # of one link always agree (the knobs are env-propagated).
+    send_dig = mesh.new_digest() \
+        if n_send and mesh.deferred_digests_for(nxt) else None
+    recv_dig = mesh.new_digest() \
+        if n_recv and mesh.deferred_digests_for(prv) else None
     code = 0
     send_stage = recv_stage = None
     if compressor is not None:

@@ -119,6 +119,31 @@ HOROVOD_FUSION_ORDER = "HOROVOD_FUSION_ORDER"
 # pool after this many seconds (0 = permanent, the reference behavior).
 HOROVOD_BLACKLIST_COOLDOWN_SECS = "HOROVOD_BLACKLIST_COOLDOWN_SECS"
 # -- host data plane --
+# Per-link transport selection (transport/select.py; docs/data_plane.md):
+# "auto" (default — shared-memory rings for intra-host links, TCP for
+# cross-host), "tcp" (everything over the TCP mesh, the pre-PR-11
+# behavior), or "shm" (force shm on every link; a cross-host link under
+# "shm" is a loud config error, not a silent TCP fallback).  All ranks
+# must agree (launcher-propagated like every knob).
+HOROVOD_TRANSPORT = "HOROVOD_TRANSPORT"
+# Per-frame CRC32 on the shared-memory transport ("1"/"0", default OFF —
+# the bytes never hit a wire, and host RAM is already ECC's jurisdiction;
+# turn on to debug a suspected stomper or to run the corruption chaos
+# tests against the shm path).  When on, the shadow-digest machinery
+# (HOROVOD_WIRE_CRC_SHADOW / HOROVOD_WIRE_DIGEST) applies exactly as on
+# TCP.  Both endpoints of a pair must agree.
+HOROVOD_SHM_CRC = "HOROVOD_SHM_CRC"
+# Per-direction byte capacity of each shm pair segment's ring
+# (transport/shm.py).  Frames larger than this stream through in chunks,
+# so it bounds memory, not frame size; one segment costs
+# 2*ring_bytes + header per intra-host pair in /dev/shm.
+HOROVOD_SHM_RING_BYTES = "HOROVOD_SHM_RING_BYTES"
+# Override for this rank's host-identity string (default: a physical-
+# machine probe — boot id + /dev/shm device — combined with the
+# topology's cross_rank, so simulated multi-host tests on one box
+# classify links exactly like real multi-host jobs).  Two ranks get an
+# shm link iff their identity strings are equal.
+HOROVOD_SHM_HOSTID = "HOROVOD_SHM_HOSTID"
 # Ring-collective pipeline granularity (bytes): each ring step streams its
 # chunk as segments of this size so segment k reduces in numpy while
 # segment k+1 is on the wire (backend/cpu_ring.py; docs/data_plane.md).
@@ -235,6 +260,10 @@ DEFAULT_TCP_PROGRESS_DEADLINE_SECS = 600.0
 # 24.4 unpipelined — 1 MiB is at parity with unpipelined even with no
 # core to overlap on; see benchmarks/results/ring_segment_sweep.json.
 DEFAULT_RING_SEGMENT_BYTES = 1024 * 1024
+# 4 MiB per direction: holds a whole default-sized ring segment pipeline
+# (4 segments of HOROVOD_RING_SEGMENT_BYTES) without backpressure, while
+# an np=8 single-host job's 28 pairs still cost < 256 MiB of /dev/shm.
+DEFAULT_SHM_RING_BYTES = 4 * 1024 * 1024
 DEFAULT_SPARK_INLINE_MAX_ROWS = 100_000
 DEFAULT_LOCK_DEBUG_SLOW_SECS = 1.0
 # 5 s: fast enough that a scrape of a live job is near-current, slow

@@ -10,7 +10,8 @@ recv, or drop a negotiation frame and assert the survivors' behavior.
 Spec grammar (``HOROVOD_FAULT_SPEC``, clauses joined by ``;``)::
 
     clause  := site[:key=value]...
-    site    := tcp.send | tcp.recv | controller.negotiate |
+    site    := tcp.send | tcp.recv | shm.send | shm.recv |
+               controller.negotiate |
                enqueue.collective | dispatch.collective |
                rendezvous.get | worker.spawn |
                ckpt.save | store.put | store.get_serve | driver.tick
@@ -72,6 +73,8 @@ from .exceptions import FaultInjectedError
 SITES = (
     "tcp.send",
     "tcp.recv",
+    "shm.send",
+    "shm.recv",
     "controller.negotiate",
     "enqueue.collective",
     "dispatch.collective",
@@ -87,9 +90,12 @@ _ACTIONS = ("hang", "delay_ms", "raise", "raise_oserror", "exit", "drop",
             "corrupt", "truncate")
 
 #: Actions that rewrite the operation's payload instead of failing it;
-#: only ``tcp.send`` passes a payload, so they are send-only (parse-time
-#: enforced, like ``drop``).
+#: only the transport send sites pass a payload, so they are send-only
+#: (parse-time enforced, like ``drop``).
 _PAYLOAD_ACTIONS = ("drop", "corrupt", "truncate")
+
+#: The sites that carry a payload — one per transport (tcp.py, shm.py).
+_SEND_SITES = ("tcp.send", "shm.send")
 
 #: Fast-path flag: False means no spec is configured and ``inject`` is
 #: never called (sites guard on it).
@@ -181,18 +187,19 @@ def _parse_clause(text: str) -> _Clause:
                 f"unknown fault clause key {key!r} (clause: {text!r})")
     if nth is not None and after is not None:
         raise ValueError(f"nth and after are exclusive (clause: {text!r})")
-    if action in _PAYLOAD_ACTIONS and site != "tcp.send":
+    if action in _PAYLOAD_ACTIONS and site not in _SEND_SITES:
         # Only a send carries a payload to drop/mangle; every other site
         # would silently ignore the action — and a spec that injects
         # nothing must fail loudly, not pass chaos tests vacuously.
         raise ValueError(
-            f"action={action} is only valid for site tcp.send "
-            f"(clause: {text!r})")
+            f"action={action} is only valid for sites "
+            f"{'/'.join(_SEND_SITES)} (clause: {text!r})")
     return _Clause(site, rank, peer, nth, after, action, action_arg)
 
 
 class SendMutation:
-    """Verdict of a payload-mangling injection on ``tcp.send``.
+    """Verdict of a payload-mangling injection on a transport send site
+    (``tcp.send`` / ``shm.send``).
 
     ``payload`` is the LOGICAL payload (post-``truncate``): the transport
     frames and CRCs this, so a truncated frame is self-consistent and only
@@ -271,7 +278,7 @@ def inject(site: str, rank: Optional[int] = None,
         _record_fire(clause, site, rank)
         if clause.action in ("corrupt", "truncate"):
             if payload is None:
-                continue  # parse-time guard keeps these on tcp.send
+                continue  # parse-time guard keeps these on send sites
             if mutation is None:
                 mutation = SendMutation(payload, [])
             _mutate_payload(clause, mutation)

@@ -131,6 +131,17 @@ CATALOG: Dict[str, Tuple[str, str]] = {
                    "dtype (compress, widen-reduce, restore, quantize)"),
     "aborts_total": (
         "counter", "coordinated aborts, labeled dir=sent|received"),
+    # -- transport selection (transport/select.py, transport/shm.py) --
+    "shm_bytes_total": (
+        "counter", "data payload bytes framed/delivered by the shared-"
+                   "memory transport — the shm twin of "
+                   "wire_bytes_on_wire_total, counted separately because "
+                   "these bytes never cross a wire (one count per "
+                   "endpoint per data frame; control and digest-check "
+                   "frames excluded, same discipline as TCP)"),
+    "transport_links_total": (
+        "counter", "peer links classified at mesh bring-up, labeled "
+                   "transport=shm|tcp (per-link selection seam)"),
     "faults_injected_total": (
         "counter", "fault-injection clauses fired (chaos runs only)"),
     # -- registered views (phase_stats / wire_stats) --

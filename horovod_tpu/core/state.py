@@ -35,6 +35,7 @@ from ..common.exceptions import (
 )
 from ..common.logging_util import get_logger
 from ..common.topology import ProcessTopology, from_env
+from ..transport.select import build_link_mesh
 from ..transport.store import HTTPStoreClient, MemoryStore, Store
 from ..transport.tcp import TcpMesh
 from . import flight_recorder, metrics
@@ -165,9 +166,11 @@ class HorovodGlobalState:
             # (reference: workers surface through the rendezvous server and
             # horovodrun aborts if they don't within the timeout).
             store.set("worker_started", str(topo.rank), b"1")
-            self.mesh = TcpMesh(
-                topo.rank, topo.size, store, scope=f"tcp.{epoch}",
-                timeout=startup_timeout, epoch=epoch)
+            # Per-link transport selection (transport/select.py): shm for
+            # intra-host links, TCP cross-host, per HOROVOD_TRANSPORT.
+            # Under the "tcp" policy this IS a plain TcpMesh.
+            self.mesh = build_link_mesh(
+                topo, store, epoch=epoch, timeout=startup_timeout)
         fusion = env_mod.get_int(
             env_mod.HOROVOD_FUSION_THRESHOLD, env_mod.DEFAULT_FUSION_THRESHOLD)
         stall_secs = 0 if env_mod.get_bool(env_mod.HOROVOD_STALL_CHECK_DISABLE) \

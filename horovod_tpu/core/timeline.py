@@ -347,11 +347,18 @@ class CounterStats:
 
     Cheap enough to leave always-on (one dict update under a lock per
     event; the transport batches per frame, not per syscall).  Registered
-    as a metrics-registry view (``wire_*_total``)."""
+    as a metrics-registry view (``wire_*_total``).
 
-    def __init__(self):
+    ``seed`` names are present at 0 from construction (and after
+    ``reset``): a counter family that scrapes/dashboards depend on must
+    not vanish just because nothing incremented it — under
+    ``HOROVOD_TRANSPORT=auto`` on one host, ALL data frames ride shm and
+    ``bytes_on_wire`` legitimately never ticks."""
+
+    def __init__(self, seed=()):
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
+        self._seed = tuple(seed)
+        self._counts: Dict[str, int] = {name: 0 for name in self._seed}
 
     def add(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -367,12 +374,12 @@ class CounterStats:
 
     def reset(self) -> None:
         with self._lock:
-            self._counts.clear()
+            self._counts = {name: 0 for name in self._seed}
 
 
 #: Process-global data-plane counters (bytes_on_wire, heap_copies);
 #: surfaced by the benches' ``--profile`` output next to ``phase_stats``.
-wire_stats = CounterStats()
+wire_stats = CounterStats(seed=("bytes_on_wire", "heap_copies"))
 
 
 # -- registry views: fold the pre-existing accumulators into every

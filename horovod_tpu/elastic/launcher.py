@@ -27,6 +27,7 @@ from ..runner.launch import (
     spawn_worker,
 )
 from ..runner.rendezvous import RendezvousServer
+from ..transport.shm import sweep_dead_segments
 from .discovery import FixedHosts, HostDiscoveryScript, HostManager
 from .driver import ElasticDriver
 from .registration import FAILURE, SUCCESS
@@ -148,6 +149,10 @@ def launch_elastic_job(args, command: List[str]) -> int:
             if procs.get(identity) is proc:
                 procs.pop(identity, None)
         log.info("worker %s exited with %d", identity, code)
+        if code != 0:
+            # A crashed worker never ran ShmMesh.close(); reclaim its
+            # /dev/shm ring segments before the next epoch respawns here.
+            sweep_dead_segments([proc.pid])
         driver.record_worker_exit(slot, code)
 
     try:
@@ -179,4 +184,5 @@ def launch_elastic_job(args, command: List[str]) -> int:
             for proc in procs.values():
                 if proc.poll() is None:
                     proc.kill()
+            sweep_dead_segments([proc.pid for proc in procs.values()])
         server.stop()

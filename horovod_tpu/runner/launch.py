@@ -390,6 +390,12 @@ def launch_job(args, command: List[str]) -> int:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        # Workers that died mid-step (SIGKILL, OOM) can leave their
+        # shared-memory ring segments behind in /dev/shm — the creator
+        # never reached ShmMesh.close().  Segment names embed the
+        # creator's pid, so sweep by the pids we just reaped.
+        from ..transport.shm import sweep_dead_segments
+        sweep_dead_segments([p.pid for p in procs])
         server.stop()
 
 

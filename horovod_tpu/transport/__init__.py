@@ -1,2 +1,4 @@
 from .store import HTTPStoreClient, MemoryStore, Store  # noqa: F401
-from .tcp import TcpMesh  # noqa: F401
+from .tcp import AbortState, TcpMesh  # noqa: F401
+from .shm import ShmMesh  # noqa: F401
+from .select import LinkMesh, build_link_mesh  # noqa: F401

@@ -1,0 +1,388 @@
+"""Per-link transport selection — the dispatch seam in front of the fabrics.
+
+ROADMAP item 1 demands the backend/transport choice be "a real dispatch
+seam, not an if/else": this module is that seam for the host data plane.
+Transports self-describe into a priority-ordered REGISTRY
+(``register_transport``); at bring-up every rank publishes a host-identity
+string through the rendezvous store, and each peer link is classified by
+asking the registry for the first transport that is (a) allowed by the
+``HOROVOD_TRANSPORT`` policy and (b) eligible for that link's endpoint
+pair.  The result is a per-peer ROUTE TABLE inside :class:`LinkMesh` — a
+facade with the full ``TcpMesh`` send/recv/recv_into/sendrecv_into/
+send_abort surface whose every data call is one dict lookup away from the
+fabric that owns the link.  The collectives (``backend/cpu_ring.py``)
+never learn which fabric they ride; ``HierarchicalAllreduce``'s
+intra-host phase lands on shm and its cross-host phase on TCP purely
+because its peer sets classify that way.
+
+Host identity: ``<physical>/<cross_rank>`` — the physical part is the
+kernel boot id plus the ``/dev/shm`` device number (two containers
+sharing neither cannot shm to each other), and folding in the topology's
+``cross_rank`` makes a SIMULATED multi-host job on one box classify its
+links exactly like a real one (the hierarchical parity tests depend on
+this).  ``HOROVOD_SHM_HOSTID`` overrides the physical part.
+
+Failure domain: the facade shares ONE :class:`AbortState` across both
+fabrics and installs itself as each fabric's ``abort_relay``, so a
+poisoned shm ring aborts the TCP links in the same broadcast and vice
+versa — one failure plane, however many transports.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..common.exceptions import HorovodInternalError
+from ..common.logging_util import get_logger
+from ..core import flight_recorder, metrics
+from .shm import ShmMesh
+from .store import Store
+from .tcp import AbortState, PendingRecv, TcpMesh
+
+log = get_logger("horovod_tpu.transport.select")
+
+
+# -- host identity ----------------------------------------------------------
+
+def _physical_host_id() -> str:
+    """Best-effort physical-machine identity: boot id (stable across the
+    machine, distinct across machines and reboots) plus the /dev/shm
+    device number (distinct across containers that cannot actually share
+    segments)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        boot = socket.gethostname()
+    try:
+        dev = os.stat("/dev/shm").st_dev
+    except OSError:
+        dev = -1
+    return f"{boot}.{dev}"
+
+
+def host_identity(cross_rank: int = 0) -> str:
+    """This rank's host-identity string (module docstring).  Two ranks
+    get an shm link iff their strings compare equal."""
+    from ..common import env as env_mod
+
+    override = env_mod.get_str(env_mod.HOROVOD_SHM_HOSTID, "") or ""
+    physical = override or _physical_host_id()
+    return f"{physical}/{cross_rank}"
+
+
+def transport_policy() -> str:
+    """The validated ``HOROVOD_TRANSPORT`` policy.  A typo'd value is a
+    loud startup error, not a silent fallback to TCP."""
+    from ..common import env as env_mod
+
+    policy = (env_mod.get_str(env_mod.HOROVOD_TRANSPORT, "auto")
+              or "auto").strip().lower()
+    if policy not in ("auto", "tcp", "shm"):
+        raise HorovodInternalError(
+            f"HOROVOD_TRANSPORT={policy!r} is not one of auto|tcp|shm")
+    return policy
+
+
+# -- transport registry -----------------------------------------------------
+
+class LinkContext:
+    """Everything a transport's ``build`` hook needs to bring up its mesh
+    for the peers routed to it."""
+
+    __slots__ = ("rank", "size", "store", "epoch", "timeout",
+                 "progress_deadline", "abort_state", "host_id",
+                 "peer_hosts", "shm_scope", "base_tcp")
+
+    def __init__(self, rank: int, size: int, store: Store, epoch: int,
+                 timeout: float, progress_deadline: Optional[float],
+                 abort_state: AbortState, host_id: str,
+                 peer_hosts: Dict[int, str], shm_scope: str,
+                 base_tcp: TcpMesh):
+        self.rank = rank
+        self.size = size
+        self.store = store
+        self.epoch = epoch
+        self.timeout = timeout
+        self.progress_deadline = progress_deadline
+        self.abort_state = abort_state
+        self.host_id = host_id
+        self.peer_hosts = peer_hosts
+        self.shm_scope = shm_scope
+        self.base_tcp = base_tcp
+
+
+class TransportSpec:
+    """One registered transport: ``eligible`` judges a single link,
+    ``build`` brings up one mesh instance serving every peer the route
+    table assigned to it.  Lower ``priority`` wins under ``auto``."""
+
+    __slots__ = ("name", "priority", "eligible", "build")
+
+    def __init__(self, name: str, priority: int,
+                 eligible: Callable[[LinkContext, int], bool],
+                 build: Callable[[LinkContext, List[int]], object]):
+        self.name = name
+        self.priority = priority
+        self.eligible = eligible
+        self.build = build
+
+
+_REGISTRY: Dict[str, TransportSpec] = {}
+
+
+def register_transport(spec: TransportSpec) -> None:
+    _REGISTRY[spec.name] = spec
+
+
+def registered_transports() -> List[TransportSpec]:
+    return sorted(_REGISTRY.values(), key=lambda s: s.priority)
+
+
+def select_transport(policy: str, ctx: LinkContext, peer: int) -> str:
+    """Name of the transport carrying the link to ``peer`` — the first
+    policy-allowed, link-eligible entry in priority order.  A FORCED
+    policy whose transport cannot carry the link (shm across hosts) is a
+    loud config error: silently widening to TCP would fake the perf the
+    operator explicitly asked to measure."""
+    for spec in registered_transports():
+        if policy != "auto" and spec.name != policy:
+            continue
+        if spec.eligible(ctx, peer):
+            return spec.name
+    raise HorovodInternalError(
+        f"HOROVOD_TRANSPORT={policy} cannot carry the link "
+        f"{ctx.rank}<->{peer}: this host is {ctx.host_id!r}, peer is "
+        f"{ctx.peer_hosts.get(peer)!r} (shm needs both on one host)")
+
+
+def _shm_eligible(ctx: LinkContext, peer: int) -> bool:
+    return ctx.peer_hosts.get(peer) == ctx.host_id
+
+
+def _build_shm(ctx: LinkContext, peers: List[int]) -> ShmMesh:
+    return ShmMesh(ctx.rank, ctx.size, ctx.store, peers,
+                   scope=ctx.shm_scope, timeout=ctx.timeout,
+                   epoch=ctx.epoch,
+                   progress_deadline=ctx.progress_deadline,
+                   abort_state=ctx.abort_state)
+
+
+register_transport(TransportSpec(
+    name="shm", priority=10, eligible=_shm_eligible, build=_build_shm))
+register_transport(TransportSpec(
+    name="tcp", priority=100,
+    eligible=lambda ctx, peer: True,
+    build=lambda ctx, peers: ctx.base_tcp))
+
+
+# -- the facade -------------------------------------------------------------
+
+class LinkMesh:
+    """Route-table facade over the registered transports.
+
+    Carries the full ``TcpMesh`` surface; every per-peer call dispatches
+    through ``self._route[peer]``.  The TCP mesh is ALWAYS built
+    underneath — it is the bootstrap fabric, the cross-host fabric, and
+    every link's fallback — and anything not explicitly implemented here
+    (``wire_crc``, ``digest_algo``, ...) delegates to it."""
+
+    def __init__(self, rank: int, size: int, store: Store, *,
+                 epoch: Optional[int] = None, timeout: float = 60.0,
+                 policy: Optional[str] = None,
+                 host_id: Optional[str] = None,
+                 cross_rank: int = 0,
+                 bind_addr: str = "0.0.0.0",
+                 advertise_addr: Optional[str] = None,
+                 progress_deadline: Optional[float] = None):
+        from ..common import env as env_mod
+
+        self.rank = rank
+        self.size = size
+        self.epoch = env_mod.get_epoch() if epoch is None else epoch
+        self._policy = transport_policy() if policy is None else policy
+        self._abort_state = AbortState()
+        shm_scope = f"shm.{self.epoch}"
+        self.tcp = TcpMesh(rank, size, store, scope=f"tcp.{self.epoch}",
+                           bind_addr=bind_addr,
+                           advertise_addr=advertise_addr, timeout=timeout,
+                           epoch=self.epoch,
+                           progress_deadline=progress_deadline,
+                           abort_state=self._abort_state)
+        self.tcp.abort_relay = self.send_abort
+        self.shm: Optional[ShmMesh] = None
+        self._route: Dict[int, object] = {}
+        if size == 1:
+            self.host_id = host_id or host_identity(cross_rank)
+            return
+
+        # Host-identity exchange rides the same rendezvous store the TCP
+        # bring-up just proved out; classification is symmetric because
+        # eligibility is an equality test and policy is env-propagated.
+        self.host_id = host_id or host_identity(cross_rank)
+        store.set(shm_scope, f"host.{rank}", self.host_id.encode())
+        others = [j for j in range(size) if j != rank]
+        hosts = store.wait(shm_scope, [f"host.{j}" for j in others],
+                           timeout=timeout)
+        peer_hosts = {j: hosts[f"host.{j}"].decode() for j in others}
+        ctx = LinkContext(rank, size, store, self.epoch, timeout,
+                          progress_deadline, self._abort_state,
+                          self.host_id, peer_hosts, shm_scope, self.tcp)
+        chosen: Dict[int, str] = {
+            j: select_transport(self._policy, ctx, j) for j in others}
+        by_name: Dict[str, List[int]] = {}
+        for j, name in chosen.items():
+            by_name.setdefault(name, []).append(j)
+        built: Dict[str, object] = {}
+        for name, peers in sorted(by_name.items()):
+            mesh = _REGISTRY[name].build(ctx, peers)
+            mesh.abort_relay = self.send_abort
+            built[name] = mesh
+            metrics.inc("transport_links_total", len(peers),
+                        transport=name)
+            for j in peers:
+                self._route[j] = mesh
+        self.shm = built.get("shm")
+        flight_recorder.record(
+            "transport_routes", policy=self._policy, host=self.host_id,
+            routes={str(j): n for j, n in sorted(chosen.items())})
+        log.info("transport routes (policy=%s, host=%s): %s",
+                 self._policy, self.host_id,
+                 {j: n for j, n in sorted(chosen.items())})
+
+    # -- route introspection (tests, tools) --------------------------------
+
+    def route_table(self) -> Dict[int, str]:
+        shm_peers = set(self.shm._peers) if self.shm is not None else set()
+        return {j: ("shm" if j in shm_peers else "tcp")
+                for j in self._route}
+
+    # -- per-link dispatch --------------------------------------------------
+
+    def send(self, peer: int, payload, digest=None, wire_dtype: int = 0,
+             _check_frame: bool = False) -> None:
+        self._route[peer].send(peer, payload, digest=digest,
+                               wire_dtype=wire_dtype,
+                               _check_frame=_check_frame)
+
+    def recv(self, peer: int) -> bytes:
+        return self._route[peer].recv(peer)
+
+    def recv_into(self, peer: int, dest, digest=None,
+                  wire_dtype: int = 0) -> int:
+        return self._route[peer].recv_into(peer, dest, digest=digest,
+                                           wire_dtype=wire_dtype)
+
+    def recv_into_async(self, peer: int, dest, digest=None,
+                        wire_dtype: int = 0) -> PendingRecv:
+        return self._route[peer].recv_into_async(peer, dest, digest=digest,
+                                                 wire_dtype=wire_dtype)
+
+    def send_step_digest(self, peer: int, dig, frames: int) -> None:
+        self._route[peer].send_step_digest(peer, dig, frames)
+
+    def verify_step_digest(self, peer: int, dig, frames: int) -> None:
+        self._route[peer].verify_step_digest(peer, dig, frames)
+
+    def deferred_digests_for(self, peer: int) -> bool:
+        return self._route[peer].deferred_digests_for(peer)
+
+    @property
+    def deferred_digests(self) -> bool:
+        """Mesh-wide view kept for compatibility; ring code asks the
+        per-link :meth:`deferred_digests_for` instead."""
+        return self.tcp.deferred_digests
+
+    def new_digest(self):
+        return self.tcp.new_digest()
+
+    def sendrecv(self, send_to: int, payload, recv_from: int) -> bytes:
+        ms = self._route[send_to]
+        mr = self._route[recv_from]
+        if ms is mr:
+            return ms.sendrecv(send_to, payload, recv_from)
+        # Cross-transport step: the recv mesh's helper thread takes the
+        # recv (preserving its per-peer FIFO/digest ordering) while this
+        # thread drives the send on the other fabric.
+        done = threading.Event()
+        box: List = [None, None]
+
+        def _recv():
+            try:
+                box[0] = mr.recv(recv_from)
+            except BaseException as e:  # noqa: BLE001
+                box[1] = e
+            finally:
+                done.set()
+
+        mr._sr_submit(_recv)
+        ms.send(send_to, payload)
+        done.wait()
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
+    def sendrecv_into(self, send_to: int, payload, recv_from: int,
+                      dest) -> int:
+        ms = self._route[send_to]
+        mr = self._route[recv_from]
+        if ms is mr:
+            return ms.sendrecv_into(send_to, payload, recv_from, dest)
+        pending = mr.recv_into_async(recv_from, dest)
+        ms.send(send_to, payload)
+        return pending.wait()
+
+    # -- failure plane ------------------------------------------------------
+
+    @property
+    def _abort(self):
+        return self._abort_state.value
+
+    @_abort.setter
+    def _abort(self, value) -> None:
+        self._abort_state.value = value
+
+    def send_abort(self, reason: str, epoch: Optional[int] = None,
+                   origin_rank: Optional[int] = None) -> None:
+        """One abort, every fabric: the TCP half records the broadcast
+        (metrics + flight recorder) and reaches every rank; the shm half
+        re-broadcasts in-band so a peer blocked mid-ring unblocks without
+        waiting for anyone to drain a TCP socket."""
+        self.tcp.send_abort(reason, epoch=epoch, origin_rank=origin_rank,
+                            _relayed=True)
+        if self.shm is not None:
+            self.shm.send_abort(reason, epoch=epoch,
+                                origin_rank=origin_rank,
+                                _relayed=True, _record=False)
+
+    def close(self) -> None:
+        if self.shm is not None:
+            self.shm.close()
+        self.tcp.close()
+
+    def __getattr__(self, name: str):
+        tcp = self.__dict__.get("tcp")
+        if tcp is None:
+            raise AttributeError(name)
+        return getattr(tcp, name)
+
+
+def build_link_mesh(topo, store: Store, *, epoch: int, timeout: float,
+                    progress_deadline: Optional[float] = None):
+    """What ``core/state.py`` calls instead of constructing a TcpMesh.
+
+    Resolves the policy ONCE: under ``tcp`` the plain TcpMesh comes back
+    directly (the pre-selection-layer object, zero new moving parts);
+    under ``auto``/``shm`` the LinkMesh facade routes per link."""
+    policy = transport_policy()
+    if policy == "tcp":
+        return TcpMesh(topo.rank, topo.size, store,
+                       scope=f"tcp.{epoch}", timeout=timeout, epoch=epoch,
+                       progress_deadline=progress_deadline)
+    return LinkMesh(topo.rank, topo.size, store, epoch=epoch,
+                    timeout=timeout, policy=policy,
+                    cross_rank=int(getattr(topo, "cross_rank", 0) or 0),
+                    progress_deadline=progress_deadline)

@@ -185,6 +185,22 @@ class PendingRecv:
         return self._box[0]
 
 
+class AbortState:
+    """Mesh-wide abort flag: ``(epoch, origin_rank, reason)`` once any
+    link delivered (or this rank broadcast) a coordinated abort.
+
+    A tiny holder rather than a bare attribute so SEVERAL meshes can
+    share one flag: under a ``LinkMesh`` (transport/select.py) the TCP
+    and shm fabrics are two halves of the same failure domain — a thread
+    blocked on an shm ring must observe an abort that arrived on a TCP
+    socket within one poll quantum, and vice versa."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value: Optional[Tuple[int, int, str]] = None
+
+
 class _Peer:
     __slots__ = ("sock", "send_lock", "recv_lock", "dead", "ever_received",
                  "frames_in")
@@ -223,7 +239,8 @@ class TcpMesh:
                  advertise_addr: Optional[str] = None,
                  timeout: float = 60.0,
                  epoch: Optional[int] = None,
-                 progress_deadline: Optional[float] = None):
+                 progress_deadline: Optional[float] = None,
+                 abort_state: Optional[AbortState] = None):
         from ..common import env as env_mod
 
         self.rank = rank
@@ -260,8 +277,13 @@ class TcpMesh:
         # Mesh-wide abort state: (epoch, origin_rank, reason) once any link
         # delivered (or this rank broadcast) a coordinated abort.  Blocked
         # recvs observe it within _ABORT_POLL_SECS regardless of which
-        # socket they wait on.
-        self._abort: Optional[Tuple[int, int, str]] = None
+        # socket they wait on.  The holder may be SHARED with a sibling
+        # shm mesh under a LinkMesh (see AbortState).
+        self._abort_state = abort_state if abort_state is not None \
+            else AbortState()
+        # Set by LinkMesh: an abort detected HERE must fan out over every
+        # transport, not just this mesh's links.
+        self.abort_relay = None
         if size == 1:
             self._listener = None
             return
@@ -552,6 +574,15 @@ class TcpMesh:
         disabled)."""
         return self.wire_crc and self.crc_shadow
 
+    def deferred_digests_for(self, peer: int) -> bool:
+        """Per-LINK form of :attr:`deferred_digests` — the seam the ring
+        collectives ask so a mixed-transport mesh (LinkMesh) can answer
+        differently per peer.  Both endpoints of a link answer alike
+        (each transport's CRC knobs are env-propagated to all ranks), so
+        the two directions of one ring step may differ but one link's
+        framing never does.  On a plain TcpMesh every link agrees."""
+        return self.deferred_digests
+
     def new_digest(self) -> digest_mod.StreamDigest:
         """Fresh chained digest for one direction of one ring step."""
         return digest_mod.StreamDigest(self.digest_algo)
@@ -568,6 +599,14 @@ class TcpMesh:
         t0 = time.perf_counter()
         dig.update(view)
         metrics.inc("crc_shadow_seconds_total", time.perf_counter() - t0)
+
+    @property
+    def _abort(self) -> Optional[Tuple[int, int, str]]:
+        return self._abort_state.value
+
+    @_abort.setter
+    def _abort(self, value: Optional[Tuple[int, int, str]]) -> None:
+        self._abort_state.value = value
 
     def _check_alive(self, p: _Peer, peer: int) -> None:
         if self._abort is not None:
@@ -1050,7 +1089,8 @@ class TcpMesh:
                                     frame.reason)
 
     def send_abort(self, reason: str, epoch: Optional[int] = None,
-                   origin_rank: Optional[int] = None) -> None:
+                   origin_rank: Optional[int] = None,
+                   _relayed: bool = False) -> None:
         """Broadcast a coordinated abort over every surviving link.
 
         Best-effort and non-blocking-ish (bounded lock waits + socket
@@ -1058,8 +1098,15 @@ class TcpMesh:
         a wedged peer.  Also flips this mesh's own abort flag so any local
         thread still blocked in a recv (e.g. the sendrecv helper) unblocks
         within one poll quantum.  ``origin_rank`` lets a RELAY of someone
-        else's abort keep the original detector's identity."""
+        else's abort keep the original detector's identity.
+
+        Under a LinkMesh, ``abort_relay`` redirects the broadcast to the
+        facade so it reaches EVERY transport's links (``_relayed`` marks
+        the facade's call back down and breaks the recursion)."""
         if self._closed or self.size == 1:
+            return
+        if not _relayed and self.abort_relay is not None:
+            self.abort_relay(reason, epoch=epoch, origin_rank=origin_rank)
             return
         from ..core.messages import AbortFrame
 

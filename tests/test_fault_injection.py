@@ -35,8 +35,11 @@ pytestmark = pytest.mark.chaos
 
 # Chaos workers run with a short recv progress deadline so hang-flavored
 # faults convert to PeerGoneError within seconds, not the 600 s production
-# default.
-_FAST_DEADLINE = {"HOROVOD_TCP_PROGRESS_DEADLINE_SECS": "3"}
+# default.  Transport pinned to tcp: these scenarios inject on the
+# tcp.* sites, which the auto policy would route around on a single host
+# (the shm twins live in test_shm_transport.py).
+_FAST_DEADLINE = {"HOROVOD_TCP_PROGRESS_DEADLINE_SECS": "3",
+                  "HOROVOD_TRANSPORT": "tcp"}
 
 
 @pytest.fixture(autouse=True)
