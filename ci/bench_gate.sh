@@ -62,6 +62,11 @@ run_case() {
 }
 
 mkdir -p benchmarks/results
+# Sweep stale flight-recorder dumps before the verdict runs: an earlier
+# wedged job's hvd_flight_recorder/ post-mortems in the cwd would make
+# any dump-presence check (and a human reading the artifacts dir) blame
+# this run for last week's failure.
+rm -rf hvd_flight_recorder/ hvd_flight_recorder.rank*.json
 rc=0
 run_case aa-null "no significant difference" \
     benchmarks/results/ab_aa_gate.json || rc=$?

@@ -25,6 +25,12 @@ set -eu
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$ROOT"
 
+# Sweep stale flight-recorder dumps BEFORE asserting: a crashed or
+# aborted earlier run leaves hvd_flight_recorder/ post-mortems in the
+# cwd, and any "dump exists / dump absent" assertion in the suite would
+# then judge last week's wreckage instead of this run's.
+rm -rf hvd_flight_recorder/ hvd_flight_recorder.rank*.json
+
 # No `... | tee` here: plain sh has no pipefail, so a pipeline would
 # swallow pytest's exit status and always report PASSED.
 rc=0

@@ -1,10 +1,11 @@
 #!/bin/sh
 # Lint lane (mirrors ci/chaos.sh): the hvd-lint static pass over the
-# package plus its own test suite (per-rule fixtures, the zero-violation
-# tree contract, and the lockdep unit tests).  Fast — run it FIRST: a
-# reopened invariant (blocking call under a lock, typo'd fault site,
-# swallowed thread exception) fails here in seconds instead of wedging a
-# multiprocess job in the chaos lane.
+# package, the hvd-mck exhaustive model-check of the shm ring protocol,
+# plus their test suites (per-rule fixtures, the zero-violation tree
+# contract, the mutation-kill suite, and the lockdep unit tests).  Fast
+# — run it FIRST: a reopened invariant (blocking call under a lock,
+# typo'd fault site, reordered doorbell publish) fails here in seconds
+# instead of wedging a multiprocess job in the chaos lane.
 #
 #   sh ci/lint.sh [extra pytest args...]
 set -eu
@@ -14,8 +15,29 @@ cd "$ROOT"
 rc=0
 {
     python -m horovod_tpu.tools.lint horovod_tpu/ &&
-    JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py tests/test_lockdep.py \
-        -q -p no:cacheprovider "$@"
+    # The deployment claim: every scenario, fully explored, zero
+    # violations — truncation exits 2 and fails the lane (an incomplete
+    # exploration must never pass as exhaustive).  The JSON report is
+    # the lane's machine-readable artifact.
+    python -m horovod_tpu.tools.mck --mode tso --smoke -q \
+        --json ci/mck.last.report.json &&
+    # The counterfactual: under store-store reordering the checker MUST
+    # find the missed wakeup (exit 1, specifically — not a crash).  A
+    # weak run that passes means the checker went blind; fail the lane.
+    { weak_rc=0; python -m horovod_tpu.tools.mck --mode weak -q \
+          > /dev/null 2>&1 || weak_rc=$?
+      if [ "$weak_rc" -eq 1 ]; then
+          echo "hvd-mck: weak-memory run finds the missed wakeup (expected)"
+      else
+          echo "hvd-mck: weak-memory run exited $weak_rc, expected 1" \
+               "(violations found) — the checker can no longer detect" \
+               "the bug class it exists for"
+          false
+      fi; } &&
+    # The checker's checker: every seeded protocol bug killed by name.
+    python -m horovod_tpu.tools.mck --mutants -q &&
+    JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py tests/test_mck.py \
+        tests/test_lockdep.py -q -p no:cacheprovider "$@"
 } > ci/lint.last.log 2>&1 || rc=$?
 cat ci/lint.last.log
 [ "$rc" -eq 0 ] || { echo "lint lane FAILED (rc=$rc)"; exit "$rc"; }

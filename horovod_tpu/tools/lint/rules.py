@@ -450,15 +450,20 @@ class WireTagInvariants(Rule):
     """Frames are distinguished on the wire ONLY by their leading magic,
     and the transport's frame header is ``<Q len|flags><I crc32>`` — the
     length word's top bit reserved for control frames (AbortFrame), the
-    CRC field owned by the transport alone.  Two classes sharing a magic,
-    a frame class without one, messages.py reaching for the control bit
-    or computing its own wire CRC, or the transport's header structs
-    drifting from the documented layout all produce positional-framing
-    desyncs (or silently unverified bytes) that surface as 'survivors
-    read negotiation bytes as tensor data'."""
+    CRC field owned by the transport layer alone.  Two classes sharing a
+    magic, a frame class without one, messages.py reaching for the
+    control bit or computing its own wire CRC, or the header registry's
+    structs drifting from the documented layout all produce
+    positional-framing desyncs (or silently unverified bytes) that
+    surface as 'survivors read negotiation bytes as tensor data'.
+
+    The header VALUES are checked in ``transport/frame_bits.py``, the
+    registry every transport imports from (HVD008 enforces that nothing
+    re-derives them elsewhere)."""
 
     code = "HVD005"
-    title = "wire framing invariant (core/messages.py, transport/tcp.py)"
+    title = "wire framing invariant (core/messages.py, " \
+            "transport/frame_bits.py)"
 
     #: The frame-header layout contract (docs/integrity.md): the length
     #: word and the CRC field each live in exactly one module-level
@@ -466,8 +471,13 @@ class WireTagInvariants(Rule):
     #: every peer built from a different revision.
     _HEADER_STRUCTS = {"_LEN": "<Q", "_CRC": "<I"}
 
+    #: The flag-bit reservations (docs/data_plane.md): each must be
+    #: declared as ``1 << bit`` so mixed-version skew analysis and the
+    #: model checker's wire assumptions stay true by inspection.
+    _FLAG_BITS = {"_CTRL_FLAG": 63, "_DEFER_FLAG": 62, "_DIGEST_FLAG": 61}
+
     def check(self, ctx, project):
-        if ctx.rel_path.endswith("transport/tcp.py"):
+        if ctx.rel_path.endswith("transport/frame_bits.py"):
             yield from self._check_transport_header(ctx)
             return
         if not ctx.rel_path.endswith("core/messages.py"):
@@ -506,8 +516,8 @@ class WireTagInvariants(Rule):
                     ctx, lit,
                     "core/messages.py must not touch the length-header top "
                     "bit (1 << 63): it is the transport's control-frame "
-                    "flag, reserved for AbortFrame marking in "
-                    "transport/tcp.py")
+                    "flag, reserved as _CTRL_FLAG in "
+                    "transport/frame_bits.py")
             if isinstance(node, ast.Call) \
                     and _terminal_name(node.func) == "crc32":
                 yield self._v(
@@ -518,12 +528,13 @@ class WireTagInvariants(Rule):
                     "here would drift from it)")
 
     def _check_transport_header(self, ctx) -> Iterator[Violation]:
-        """transport/tcp.py owns the frame header: ``_LEN``/``_CRC``
-        structs with the documented formats, and the ``_CTRL_FLAG = 1 <<
-        63`` reservation, must all exist exactly as declared — the wire
-        contract every peer and every doc (docs/integrity.md) assumes."""
+        """transport/frame_bits.py owns the frame header: ``_LEN``/
+        ``_CRC`` structs with the documented formats, and the flag-bit
+        reservations (``_CTRL_FLAG = 1 << 63`` and friends), must all
+        exist exactly as declared — the wire contract every peer and
+        every doc (docs/integrity.md) assumes."""
         structs: Dict[str, object] = {}
-        ctrl_ok = False
+        flags: Dict[str, bool] = {name: False for name in self._FLAG_BITS}
         for node in ctx.tree.body:
             if not isinstance(node, ast.Assign):
                 continue
@@ -535,15 +546,16 @@ class WireTagInvariants(Rule):
                         and _terminal_name(v.func) == "Struct" \
                         and v.args and isinstance(v.args[0], ast.Constant):
                     structs[tgt.id] = (v.args[0].value, node)
-                if tgt.id == "_CTRL_FLAG" \
-                        and self._ctrl_bit_literal(v) is not None:
-                    ctrl_ok = True
+                bit = self._FLAG_BITS.get(tgt.id)
+                if bit is not None \
+                        and self._bit_literal(v, bit) is not None:
+                    flags[tgt.id] = True
         for name, fmt in self._HEADER_STRUCTS.items():
             got = structs.get(name)
             if got is None:
                 yield Violation(
                     self.code, ctx.path, 1, 0,
-                    f"transport/tcp.py must declare {name} = "
+                    f"transport/frame_bits.py must declare {name} = "
                     f"struct.Struct({fmt!r}) (frame-header layout "
                     "contract: <Q len|flags><I crc32>)")
             elif got[0] != fmt:
@@ -552,12 +564,13 @@ class WireTagInvariants(Rule):
                     f"frame-header struct {name} must use format {fmt!r} "
                     f"(found {got[0]!r}); peers built from a different "
                     "layout desync on every frame")
-        if not ctrl_ok:
-            yield Violation(
-                self.code, ctx.path, 1, 0,
-                "transport/tcp.py must reserve the length-header top bit "
-                "as _CTRL_FLAG = 1 << 63 (the control-frame marking "
-                "AbortFrame delivery depends on)")
+        for name, bit in self._FLAG_BITS.items():
+            if not flags[name]:
+                yield Violation(
+                    self.code, ctx.path, 1, 0,
+                    f"transport/frame_bits.py must reserve length-header "
+                    f"bit {bit} as {name} = 1 << {bit} (the flag-lane "
+                    "contract mixed-version skew detection depends on)")
 
     #: every Writer method that appends bytes — the magic must precede
     #: ALL of them, not just the first u32 (a u8 written before the u32
@@ -605,6 +618,20 @@ class WireTagInvariants(Rule):
             return node
         if isinstance(node, ast.Constant) and isinstance(node.value, int) \
                 and node.value >= 2 ** 63:
+            return node
+        return None
+
+    @staticmethod
+    def _bit_literal(node: ast.AST, bit: int) -> Optional[ast.AST]:
+        """``1 << bit`` (or the equivalent integer constant), exactly."""
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.LShift) \
+                and isinstance(node.left, ast.Constant) \
+                and node.left.value == 1 \
+                and isinstance(node.right, ast.Constant) \
+                and node.right.value == bit:
+            return node
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and node.value == 2 ** bit:
             return node
         return None
 
@@ -750,6 +777,136 @@ class MetricCatalogRule(Rule):
                     "operator-facing registry mirror)")
 
 
+# ---------------------------------------------------------------------------
+# HVD008 — frame-header bit literals live only in transport/frame_bits.py
+# ---------------------------------------------------------------------------
+
+class FrameBitRegistry(Rule):
+    """The length word's top byte (bits 56-63) is the wire flag/dtype
+    lane: control, digest-deferred, digest-check, and the cast-on-the-
+    wire dtype code.  Those positions are the cross-transport,
+    cross-VERSION contract — tcp and shm must agree with each other and
+    with every older peer — so they are defined exactly once, in
+    ``transport/frame_bits.py``, and imported everywhere else.  A ``<<
+    56``..``<< 63`` literal (or a re-binding of a registry name) in any
+    other module is a second derivation of the same bit position: the
+    pre-extraction tree had tcp.py owning the bits while shm.py
+    re-derived some and imported the rest, which is exactly how framing
+    contracts drift apart."""
+
+    code = "HVD008"
+    title = "frame-header bit literal outside transport/frame_bits.py"
+
+    #: Names frame_bits.py exports; re-binding one elsewhere forks the
+    #: registry even without a raw bit literal.
+    _REGISTRY_NAMES = frozenset({
+        "_LEN", "_CRC", "_CTRL_FLAG", "_DEFER_FLAG", "_DIGEST_FLAG",
+        "_WIRE_DTYPE_SHIFT", "_WIRE_DTYPE_MASK", "_FLAGS_MASK",
+        "_DIGEST_PAYLOAD", "_FrameHeader", "_MAX_FRAME_BYTES",
+    })
+    _FLAG_BIT_RANGE = range(56, 64)
+
+    def check(self, ctx, project):
+        if ctx.rel_path.endswith("transport/frame_bits.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.LShift) \
+                    and isinstance(node.right, ast.Constant) \
+                    and isinstance(node.right.value, int) \
+                    and node.right.value in self._FLAG_BIT_RANGE:
+                yield self._v(
+                    ctx, node,
+                    f"frame-header bit literal (<< {node.right.value}): "
+                    "bits 56-63 of the length word are the wire "
+                    "flag/dtype lane, defined once in "
+                    "transport/frame_bits.py — import the named constant "
+                    "instead of re-deriving the position")
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id in self._REGISTRY_NAMES:
+                        yield self._v(
+                            ctx, node,
+                            f"re-binding of frame-bit registry name "
+                            f"{tgt.id}: transport/frame_bits.py is the "
+                            "single source of the frame-header contract; "
+                            "import it, don't shadow it")
+
+
+# ---------------------------------------------------------------------------
+# HVD009 — shm control words move only through the accessor helpers
+# ---------------------------------------------------------------------------
+
+class ShmAccessorDiscipline(Rule):
+    """The shm ring's correctness argument is machine-checked (hvd-mck)
+    over the step generators, and the proof only covers accesses the
+    model can see.  ``transport/shm.py`` therefore funnels EVERY raw
+    struct move against a header offset through four accessors
+    (``_load_u64``/``_store_u64``/``_load_u32``/``_store_u32``) so the
+    set of shared-memory control-word accesses is closed by
+    construction.  A raw ``unpack_from``/``pack_into`` against an
+    ``_OFF_*`` constant (or a ``*_head_off``/``*_tail_off``/
+    ``*_bell_off``/``*_pid_off`` attribute) anywhere else is a
+    shared-memory access the checker never explored — an unverified hole
+    in a verified protocol."""
+
+    code = "HVD009"
+    title = "raw struct access against shm control-word offsets"
+
+    _ACCESSORS = frozenset({"_load_u64", "_store_u64",
+                            "_load_u32", "_store_u32"})
+    _STRUCT_METHODS = frozenset({"unpack_from", "pack_into"})
+    _OFF_CONST_RE = re.compile(r"^_OFF_[A-Z0-9_]+$")
+    _OFF_ATTR_RE = re.compile(r"(^|_)(head|tail|bell|pid)_off$")
+
+    def check(self, ctx, project):
+        in_shm = ctx.rel_path.endswith("transport/shm.py")
+        yield from self._scan(ctx, ctx.tree, None, in_shm)
+
+    def _scan(self, ctx, node, fn_name, in_shm) -> Iterator[Violation]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan(ctx, child, child.name, in_shm)
+                continue
+            if isinstance(child, ast.Call) \
+                    and _terminal_name(child.func) in self._STRUCT_METHODS \
+                    and not (in_shm and fn_name in self._ACCESSORS):
+                yield from self._check_call(ctx, child, in_shm)
+            yield from self._scan(ctx, child, fn_name, in_shm)
+
+    def _check_call(self, ctx, call, in_shm) -> Iterator[Violation]:
+        offending = None
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                name = _terminal_name(sub)
+                if name is not None and self._is_offset_name(name):
+                    offending = name
+                    break
+            if offending:
+                break
+        method = _terminal_name(call.func)
+        if offending:
+            yield self._v(
+                ctx, call,
+                f"raw {method} against shm header offset {offending}: "
+                "control words move only through the "
+                "_load_u64/_store_u64/_load_u32/_store_u32 accessors "
+                "(the model-checked access set is closed by "
+                "construction)")
+        elif in_shm:
+            yield self._v(
+                ctx, call,
+                f"raw struct {method} in transport/shm.py outside the "
+                "control-word accessors: every shared-memory struct move "
+                "must go through _load_u64/_store_u64/_load_u32/"
+                "_store_u32 so hvd-mck's access model stays exhaustive")
+
+    def _is_offset_name(self, name: str) -> bool:
+        return self._OFF_CONST_RE.match(name) is not None \
+            or self._OFF_ATTR_RE.search(name) is not None
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     BlockingUnderLock(),
     EnvLiteralOutsideRegistry(),
@@ -758,6 +915,8 @@ ALL_RULES: Tuple[Rule, ...] = (
     WireTagInvariants(),
     AnonymousThread(),
     MetricCatalogRule(),
+    FrameBitRegistry(),
+    ShmAccessorDiscipline(),
 )
 
 RULE_CODES = frozenset(r.code for r in ALL_RULES) | {"HVD000"}

@@ -1,0 +1,179 @@
+"""hvd-mck — exhaustive-interleaving model checker for the shm ring.
+
+The shm data plane's correctness argument is an ordering argument: data
+bytes land before the head/tail that publishes them, the bell is read
+before the ring state it guards, every bell store is chased by a
+FUTEX_WAKE, and x86-64's TSO store ordering carries those program
+orders to the other core.  Prose arguments about lock-free protocols
+have a famous failure rate, so this tool checks the REAL protocol code
+— :func:`~horovod_tpu.transport.shm.sender_steps` /
+``receiver_steps``, the same generators the production drivers execute
+against live segments — by driving it through every schedule up to a
+preemption bound under an explicit store-buffer memory model.
+
+Two memory models, selected by ``--mode``:
+
+- ``tso`` (the deployment claim): store buffers drain strictly in FIFO
+  order.  The exhaustive run must be clean — no missed wakeup, no lost
+  or reordered byte, no unpublished read, no deadlock, every bell store
+  paired with a wake, abort reachable from every blocked state.
+- ``weak`` (the counterfactual): buffered stores may drain in ANY
+  order, i.e. store-store reordering is allowed.  The run must FAIL,
+  exhibiting the concrete missed-wakeup schedule the doorbell protocol
+  would suffer on a weaker machine (or if a "harmless" refactor let the
+  compiler hoist the bell store).  A checker that cannot find the bug
+  the protocol was designed against proves nothing by passing.
+
+``--mutants`` runs the seeded-bug suite (mutations.py): four classic
+ring-protocol bugs injected into the op stream, each of which the
+exhaustive run must kill with a named violation and a minimal
+reproducing schedule.  CI wires all three runs into ci/lint.sh; see
+docs/static_analysis.md for the full invariant list and how to add a
+protocol.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from .explore import ExploreResult, check
+from .mutations import MUTATIONS
+from .report import render_result, summary_line, write_json
+from .scenarios import SCENARIOS
+
+
+def _parser() -> argparse.ArgumentParser:
+    par = argparse.ArgumentParser(
+        prog="hvd-mck",
+        description="bounded-exhaustive model checker for the shm ring "
+                    "+ futex doorbell protocol")
+    par.add_argument("--mode", choices=("tso", "weak"), default="tso",
+                     help="memory model: tso (deployment claim, must "
+                          "pass) or weak (store-store reordering, must "
+                          "find the missed wakeup)")
+    par.add_argument("--scenario", action="append", default=None,
+                     metavar="NAME",
+                     help="scenario to check (repeatable; default: all)")
+    par.add_argument("--preemptions", type=int, default=None,
+                     help="override the per-scenario preemption bound")
+    par.add_argument("--max-schedules", type=int, default=50000,
+                     help="schedule cap per run; hitting it reports the "
+                          "run as TRUNCATED, never as proved")
+    par.add_argument("--max-steps", type=int, default=600,
+                     help="per-schedule action budget (livelock trip)")
+    par.add_argument("--mutation", metavar="NAME",
+                     help="run one seeded mutation from the kill suite")
+    par.add_argument("--mutants", action="store_true",
+                     help="run the full mutation-kill suite: exit 0 iff "
+                          "every seeded bug is caught")
+    par.add_argument("--smoke", action="store_true",
+                     help="CI gate: all scenarios under the given mode; "
+                          "exit 2 if any run truncated (an incomplete "
+                          "exploration must not pass as exhaustive)")
+    par.add_argument("--json", metavar="PATH",
+                     help="write the machine-readable report here")
+    par.add_argument("--no-sleep-sets", action="store_true",
+                     help="disable sleep-set pruning (slower; debug aid "
+                          "for auditing the reduction)")
+    par.add_argument("--list", action="store_true",
+                     help="list scenarios and mutations, then exit")
+    par.add_argument("-q", "--quiet", action="store_true",
+                     help="print only the summary line and violations")
+    return par
+
+
+def _print_listing() -> None:
+    print("scenarios:")
+    for sc in SCENARIOS.values():
+        print(f"  {sc.name:8s} cap={sc.cap} "
+              f"send={sc.send_calls} recv={sc.recv_calls} "
+              f"abort={sc.abort} preemptions<={sc.preemptions}")
+        print(f"           {sc.description}")
+    print("mutations (kill suite):")
+    for mut in MUTATIONS.values():
+        print(f"  {mut.name:22s} [{mut.role} @ {mut.scenario}] "
+              f"-> {', '.join(sorted(mut.expected))}")
+        print(f"           {mut.description}")
+
+
+def _run_mutants(args, names: List[str]) -> int:
+    results: List[ExploreResult] = []
+    unkilled: List[str] = []
+    for name in names:
+        mut = MUTATIONS[name]
+        scenario = SCENARIOS[mut.scenario]
+        res = check(scenario, args.mode, mutation=mut,
+                    bound=args.preemptions,
+                    max_schedules=args.max_schedules,
+                    max_steps=args.max_steps,
+                    sleep_sets=not args.no_sleep_sets)
+        results.append(res)
+        caught = set(res.violations) & mut.expected
+        if caught:
+            if not args.quiet:
+                print(render_result(res))
+                print(f"  KILLED by {', '.join(sorted(caught))}")
+        else:
+            unkilled.append(name)
+            print(render_result(res))
+            found = ", ".join(sorted(res.violations)) or "nothing"
+            print(f"  NOT KILLED: expected one of "
+                  f"{', '.join(sorted(mut.expected))}, found {found}")
+    if args.json:
+        write_json(results, args.mode, args.json)
+    print(summary_line(results))
+    if unkilled:
+        print(f"hvd-mck: mutation suite FAILED — surviving mutants: "
+              f"{', '.join(unkilled)} (the checker's bounds no longer "
+              f"catch seeded bugs)")
+        return 1
+    print(f"hvd-mck: mutation suite passed — "
+          f"{len(names)}/{len(names)} mutants killed")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list:
+        _print_listing()
+        return 0
+
+    if args.mutation or args.mutants:
+        if args.mutation:
+            if args.mutation not in MUTATIONS:
+                print(f"hvd-mck: unknown mutation {args.mutation!r} "
+                      f"(have: {', '.join(MUTATIONS)})", file=sys.stderr)
+                return 2
+            names = [args.mutation]
+        else:
+            names = list(MUTATIONS)
+        return _run_mutants(args, names)
+
+    names = args.scenario or list(SCENARIOS)
+    for name in names:
+        if name not in SCENARIOS:
+            print(f"hvd-mck: unknown scenario {name!r} "
+                  f"(have: {', '.join(SCENARIOS)})", file=sys.stderr)
+            return 2
+    results = []
+    for name in names:
+        res = check(SCENARIOS[name], args.mode, bound=args.preemptions,
+                    max_schedules=args.max_schedules,
+                    max_steps=args.max_steps,
+                    sleep_sets=not args.no_sleep_sets)
+        results.append(res)
+        if not args.quiet or not res.ok:
+            print(render_result(res))
+    if args.json:
+        write_json(results, args.mode, args.json)
+    print(summary_line(results))
+    if any(not r.ok for r in results):
+        return 1
+    if args.smoke and any(r.truncated for r in results):
+        print("hvd-mck: smoke run truncated — raise --max-schedules or "
+              "shrink the scenario; an incomplete exploration is not a "
+              "proof", file=sys.stderr)
+        return 2
+    return 0

@@ -1,0 +1,8 @@
+"""``python -m horovod_tpu.tools.mck`` — see the package docstring."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,551 @@
+"""Deterministic execution model for the shm ring + futex-doorbell protocol.
+
+One :class:`Execution` is one run of the two protocol threads (sender
+"S", receiver "R") under an explicit schedule.  The threads are the REAL
+:func:`~horovod_tpu.transport.shm.sender_steps` /
+:func:`~horovod_tpu.transport.shm.receiver_steps` generators — the same
+objects the production drivers execute against live segments — driven
+here against a model memory with an explicit store-buffer semantics:
+
+- Every store a thread issues lands in its private per-thread store
+  buffer first and becomes globally visible only when a FLUSH action is
+  scheduled.  The ``tso`` memory model flushes strictly in FIFO order
+  (the x86-64 guarantee the production comment relies on); the ``weak``
+  model may flush ANY buffered entry next, i.e. it permits store-store
+  reordering.
+- A thread's own loads read through its buffer (newest matching entry
+  wins) — a core always sees its own stores.
+- The futex syscalls (OP_WAIT / OP_WAKE) first drain the CALLING
+  thread's buffer to global memory, modeling the locked kernel
+  operations inside the syscall that act as a full barrier on the
+  caller's core, then operate on global state: WAIT re-reads the bell
+  and sleeps only if it still equals the expected value; WAKE wakes
+  every current sleeper (FUTEX_WAKE with INT_MAX, as production does).
+  This is deliberately the REALISTIC syscall semantics: under ``weak``
+  the protocol must break via flush-agent reordering alone, which is
+  exactly the store-store fence the production protocol leans on.
+- Timeouts exist only as the abort-propagation path: once the scenario's
+  abort has fired, a sleeping thread may be woken by a TIMEOUT action
+  (the bounded ``_BELL_WAIT_SECS`` wait expiring).  Spurious timeouts
+  are not modeled — a "missed wakeup" here means the production thread
+  would burn a full bounded wait with progress already published, the
+  exact latency bug the doorbell exists to prevent.
+
+Scheduling granularity: one action executes one VISIBLE op — a load, a
+receiver ring copy, a wait, a wake, or (in abort scenarios) a poll.
+Thread-local ops (stores and sender copies, which only enter the private
+buffer, plus polls when no abort can ever fire) auto-execute attached to
+the preceding visible op; they commute with every other agent's actions,
+so no interleaving is lost.  Payload bytes are modeled as their global
+sequence number, so FIFO/lost-byte/overwrite violations are detected the
+moment the receiver lands a wrong byte.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ...transport.shm import (
+    ABORTED,
+    DONE,
+    LOC_BELL_OWN,
+    LOC_BELL_PEER,
+    LOC_HEAD,
+    LOC_TAIL,
+    OP_COPY,
+    OP_LOAD,
+    OP_POLL,
+    OP_STORE,
+    OP_WAIT,
+    OP_WAKE,
+    SIG_ABORT,
+    SIG_OK,
+    receiver_steps,
+    sender_steps,
+)
+
+SENDER = "S"
+RECEIVER = "R"
+
+#: Concrete (direction-level) names for the two single-writer doorbells
+#: the role-relative LOC_BELL_OWN / LOC_BELL_PEER resolve to: the sender
+#: writes DATA_BELL and waits on SPACE_BELL; the receiver mirrors.
+DATA_BELL = "data_bell"
+SPACE_BELL = "space_bell"
+
+RUNNABLE = "runnable"
+SLEEPING = "sleeping"
+FINISHED = "finished"
+
+#: Violation names — the checker's vocabulary, referenced by tests, the
+#: mutation kill suite, and docs/static_analysis.md.
+V_MISSED_WAKEUP = "missed-wakeup"
+V_DEADLOCK = "deadlock"
+V_STARVATION = "starvation"
+V_LOST_BYTES = "lost-bytes"
+V_UNPUBLISHED_READ = "unpublished-read"
+V_LIVELOCK = "livelock"
+V_FUTEX_PAIRING = "futex-pairing"
+V_STALE_BELL = "stale-bell"
+V_MODEL_ERROR = "model-error"
+
+
+class Violation:
+    """One invariant breach plus the schedule that reproduces it."""
+
+    __slots__ = ("name", "detail", "schedule")
+
+    def __init__(self, name: str, detail: str, schedule: List[str]):
+        self.name = name
+        self.detail = detail
+        self.schedule = schedule
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "detail": self.detail,
+                "schedule": list(self.schedule)}
+
+
+class Scenario:
+    """One bounded workload: per-call segment lengths for each side (one
+    generator instance per call — the per-CALL bell discipline is part of
+    the protocol under test) and whether a mesh abort may fire."""
+
+    __slots__ = ("name", "cap", "send_calls", "recv_calls", "abort",
+                 "description", "preemptions")
+
+    def __init__(self, name: str, cap: int, send_calls: List[List[int]],
+                 recv_calls: List[List[int]], abort: bool,
+                 description: str, preemptions: int):
+        if sum(map(sum, send_calls)) != sum(map(sum, recv_calls)):
+            raise ValueError(f"scenario {name}: send/recv byte mismatch")
+        self.name = name
+        self.cap = cap
+        self.send_calls = send_calls
+        self.recv_calls = recv_calls
+        self.abort = abort
+        self.description = description
+        self.preemptions = preemptions
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(map(sum, self.send_calls))
+
+
+class _Thread:
+    __slots__ = ("tid", "factories", "call", "gen", "pending", "status",
+                 "result", "fresh_bell", "last_bell", "bell_store_pending")
+
+    def __init__(self, tid: str, factories: List[Callable]):
+        self.tid = tid
+        self.factories = factories
+        self.call = 0
+        self.gen = None
+        self.pending: Optional[tuple] = None
+        self.status = RUNNABLE
+        self.result: Optional[str] = None
+        # Structural-invariant state: the bell value of the freshest
+        # precheck load (and whether one happened since the last wait),
+        # and whether a bell store still awaits its FUTEX_WAKE.
+        self.fresh_bell = False
+        self.last_bell: Optional[int] = None
+        self.bell_store_pending = False
+
+
+def unit(action: tuple) -> str:
+    """Scheduling unit for preemption accounting: a thread and its flush
+    agent are one unit (a store buffer drains on the thread's own core —
+    its progress is not a scheduler preemption); the abort injector is
+    the environment."""
+    return "env" if action[0] == "a" else action[1]
+
+
+class Execution:
+    """One schedulable run.  ``step(action)`` executes one action; the
+    caller replays prefixes to explore (generators cannot be forked)."""
+
+    def __init__(self, scenario: Scenario, memory_model: str,
+                 mutation=None, max_steps: int = 600,
+                 structural: bool = True):
+        if memory_model not in ("tso", "weak"):
+            raise ValueError(f"unknown memory model {memory_model!r}")
+        self.scenario = scenario
+        self.model = memory_model
+        self.max_steps = max_steps
+        self.structural = structural
+        self.words: Dict[str, int] = {LOC_HEAD: 0, LOC_TAIL: 0,
+                                      DATA_BELL: 0, SPACE_BELL: 0}
+        self.ring: List[Optional[int]] = [None] * scenario.cap
+        self.buffers: Dict[str, List[tuple]] = {SENDER: [], RECEIVER: []}
+        # tid -> concrete bell word the sleeper is parked on (a futex
+        # wake on one word never disturbs waiters on the other).
+        self.sleepers: Dict[str, str] = {}
+        self.abort = False
+        self.abort_armed = scenario.abort
+        self.received: List[int] = []
+        self.trace: List[str] = []
+        self.steps = 0
+        self.violation: Optional[Violation] = None
+        # Sender bytes are their global sequence number: segment idx/off
+        # within a call maps through these per-call prefixes.
+        self._send_base: List[List[int]] = []
+        base = 0
+        for lens in scenario.send_calls:
+            prefixes = []
+            for n in lens:
+                prefixes.append(base)
+                base += n
+            self._send_base.append(prefixes)
+
+        def factories(role: str, calls: List[List[int]]) -> List[Callable]:
+            step_fn = sender_steps if role == SENDER else receiver_steps
+            out = []
+            for lens in calls:
+                def make(lens=lens, step_fn=step_fn):
+                    gen = step_fn(scenario.cap, list(lens))
+                    if mutation is not None and mutation.role == role:
+                        gen = mutation.wrap(gen)
+                    return gen
+                out.append(make)
+            return out
+
+        self.threads: Dict[str, _Thread] = {
+            SENDER: _Thread(SENDER, factories(SENDER, scenario.send_calls)),
+            RECEIVER: _Thread(RECEIVER,
+                              factories(RECEIVER, scenario.recv_calls)),
+        }
+        for t in self.threads.values():
+            self._fetch(t, None, first=True)
+
+    # -- memory ------------------------------------------------------------
+
+    @staticmethod
+    def _word(tid: str, loc: str) -> str:
+        """Resolve a role-relative generator loc to a concrete shared
+        word: the sender's own bell is the data bell, the receiver's the
+        space bell, and each waits on the other's."""
+        if loc == LOC_BELL_OWN:
+            return DATA_BELL if tid == SENDER else SPACE_BELL
+        if loc == LOC_BELL_PEER:
+            return SPACE_BELL if tid == SENDER else DATA_BELL
+        return loc
+
+    def _visible(self, tid: str, loc: str) -> int:
+        for entry in reversed(self.buffers[tid]):
+            if entry[0] == "word" and entry[1] == loc:
+                return entry[2]
+        return self.words[loc]
+
+    def _apply(self, entry: tuple) -> str:
+        if entry[0] == "word":
+            self.words[entry[1]] = entry[2]
+            return f"{entry[1]}={entry[2]}"
+        for pos, seq in entry[1]:
+            self.ring[pos] = seq
+        span = entry[1]
+        return f"ring[{span[0][0]}..{span[-1][0]}]"
+
+    def _drain(self, tid: str) -> None:
+        """Syscall barrier: publish the caller's buffered stores, in
+        buffer order, before the kernel reads global state."""
+        buf = self.buffers[tid]
+        while buf:
+            self._apply(buf.pop(0))
+
+    # -- violations --------------------------------------------------------
+
+    def _violate(self, name: str, detail: str) -> None:
+        if self.violation is None:
+            self.violation = Violation(name, detail, list(self.trace))
+
+    # -- generator advancement --------------------------------------------
+
+    def _fetch(self, t: _Thread, resp, first: bool = False) -> None:
+        """Advance ``t`` past its just-completed op (answering it with
+        ``resp``) to its next VISIBLE op, auto-executing thread-local
+        ones on the way."""
+        if t.gen is None:
+            if first:
+                t.gen = t.factories[t.call]()
+                resp = None
+            else:  # pragma: no cover - defensive
+                raise RuntimeError("fetch on a finished thread")
+        while True:
+            try:
+                op = t.gen.send(resp)
+            except StopIteration as fin:
+                if t.bell_store_pending and self.structural:
+                    self._violate(
+                        V_FUTEX_PAIRING,
+                        f"{t.tid} finished a call with a bell store never "
+                        "followed by FUTEX_WAKE: any peer that went to "
+                        "sleep before the store burns a full bounded wait")
+                result = fin.value if fin.value is not None else DONE
+                t.call += 1
+                if result == ABORTED or t.call >= len(t.factories):
+                    t.gen = None
+                    t.pending = None
+                    t.status = FINISHED
+                    t.result = result
+                    return
+                t.gen = t.factories[t.call]()
+                resp = None
+                continue
+            kind = op[0]
+            if kind == OP_STORE:
+                self._exec_store(t, op)
+                resp = None
+                continue
+            if kind == OP_COPY and t.tid == SENDER:
+                base = self._send_base[t.call][op[1]]
+                _, _idx, off, pos, run = op
+                self.buffers[t.tid].append(
+                    ("ring", [(pos + k, base + off + k)
+                              for k in range(run)]))
+                resp = None
+                continue
+            if kind == OP_POLL and not self.abort_armed:
+                resp = SIG_OK
+                continue
+            t.pending = op
+            return
+
+    def _exec_store(self, t: _Thread, op: tuple) -> None:
+        loc, value = op[1], op[2]
+        if loc == LOC_BELL_OWN:
+            t.bell_store_pending = True
+        self.buffers[t.tid].append(("word", self._word(t.tid, loc), value))
+
+    # -- scheduling --------------------------------------------------------
+
+    def enabled_actions(self) -> List[tuple]:
+        if self.violation is not None:
+            return []
+        acts: List[tuple] = []
+        for t in self.threads.values():
+            if t.status == RUNNABLE and t.pending is not None:
+                acts.append(("t", t.tid))
+            elif t.status == SLEEPING and self.abort:
+                acts.append(("w", t.tid))
+        for tid, buf in self.buffers.items():
+            if buf:
+                if self.model == "tso":
+                    acts.append(("f", tid, 0))
+                else:
+                    # Store-store reordering across ADDRESSES only:
+                    # same-location stores stay in program order (cache
+                    # coherence holds even on weak machines), so a flush
+                    # may pick any entry that is the oldest for its
+                    # location.  This also keeps the thread's own
+                    # forwarded view consistent: the newest buffered
+                    # entry per location is always still buffered.
+                    seen: Set[object] = set()
+                    for i, entry in enumerate(buf):
+                        key = entry[1] if entry[0] == "word" else "ring"
+                        if key not in seen:
+                            acts.append(("f", tid, i))
+                            seen.add(key)
+        if self.abort_armed and not self.abort \
+                and any(t.status != FINISHED for t in self.threads.values()):
+            acts.append(("a",))
+        return acts
+
+    def touches(self, action: tuple) -> frozenset:
+        """Read/write footprint of an enabled action, for the explorer's
+        independence relation."""
+        kind = action[0]
+        if kind == "t":
+            tid = action[1]
+            op = self.threads[tid].pending
+            if op[0] == OP_LOAD:
+                return frozenset({("r", self._word(tid, op[1]))})
+            if op[0] == OP_COPY:
+                return frozenset({("r", "ring")})
+            if op[0] == OP_POLL:
+                return frozenset({("r", "abort")})
+            # OP_WAIT / OP_WAKE: the touched futex word plus the
+            # syscall's buffer drain.
+            if op[0] == OP_WAIT:
+                word = self._word(tid, LOC_BELL_PEER)
+                s = {("w", ("futex", word)), ("r", word)}
+            else:
+                s = {("w", ("futex", self._word(tid, LOC_BELL_OWN)))}
+            for entry in self.buffers[tid]:
+                s.add(("w", entry[1] if entry[0] == "word" else "ring"))
+            return frozenset(s)
+        if kind == "f":
+            entry = self.buffers[action[1]][action[2]]
+            return frozenset(
+                {("w", entry[1] if entry[0] == "word" else "ring")})
+        if kind == "w":
+            word = self._word(action[1], LOC_BELL_PEER)
+            return frozenset({("w", ("futex", word))})
+        return frozenset({("w", "abort")})
+
+    def step(self, action: tuple) -> None:
+        self.steps += 1
+        if self.steps > self.max_steps:
+            self._violate(
+                V_LIVELOCK,
+                f"no quiescence within {self.max_steps} scheduled actions: "
+                "the protocol is spinning without making progress")
+            return
+        kind = action[0]
+        if kind == "a":
+            self.abort = True
+            self.trace.append("env: mesh abort flag set")
+            return
+        if kind == "f":
+            tid = action[1]
+            entry = self.buffers[tid].pop(action[2])
+            desc = self._apply(entry)
+            self.trace.append(f"flush({tid}): {desc} -> shared")
+            return
+        if kind == "w":
+            tid = action[1]
+            t = self.threads[tid]
+            self.sleepers.pop(tid, None)
+            t.status = RUNNABLE
+            self.trace.append(f"{tid}: bounded wait timed out (abort set)")
+            self._fetch(t, None)
+            return
+        t = self.threads[action[1]]
+        op = t.pending
+        if self.structural and t.bell_store_pending and op[0] != OP_WAKE:
+            self._violate(
+                V_FUTEX_PAIRING,
+                f"{t.tid} moved the bell but ran {op[0]} before the "
+                "FUTEX_WAKE that publishes it to sleepers")
+            return
+        if op[0] == OP_LOAD:
+            word = self._word(t.tid, op[1])
+            value = self._visible(t.tid, word)
+            tag = op[2] if len(op) > 2 else None
+            if op[1] == LOC_BELL_PEER and tag == "precheck":
+                t.fresh_bell = True
+                t.last_bell = value
+            self.trace.append(f"{t.tid}: load {word} -> {value}")
+            self._fetch(t, value)
+        elif op[0] == OP_POLL:
+            resp = SIG_ABORT if self.abort else SIG_OK
+            self.trace.append(f"{t.tid}: poll abort -> {resp}")
+            self._fetch(t, resp)
+        elif op[0] == OP_COPY:
+            # Receiver-side ring read (the sender's copies are buffered
+            # stores, auto-executed in _fetch).
+            _, _idx, _got, pos, run = op
+            for k in range(run):
+                value = self.ring[pos + k]
+                want = len(self.received)
+                if value is None:
+                    self._violate(
+                        V_UNPUBLISHED_READ,
+                        f"receiver read ring[{pos + k}] before the "
+                        "sender's data bytes became visible: head was "
+                        "published ahead of the bytes it covers")
+                    return
+                if value != want:
+                    self._violate(
+                        V_LOST_BYTES,
+                        f"receiver landed byte seq {value} where seq "
+                        f"{want} was due (ring[{pos + k}]): bytes were "
+                        "overwritten or delivered out of order")
+                    return
+                self.received.append(value)
+            self.trace.append(
+                f"{t.tid}: copy ring[{pos}..{pos + run - 1}] out")
+            t.fresh_bell = False
+            self._fetch(t, None)
+        elif op[0] == OP_WAIT:
+            expected = op[1]
+            if self.structural and not t.fresh_bell:
+                self._violate(
+                    V_STALE_BELL,
+                    f"{t.tid} armed FUTEX_WAIT with a bell value not "
+                    "re-read since its last wait/copy: a bump between "
+                    "the stale read and this wait is invisible and the "
+                    "wait can no longer be cut short")
+                return
+            if self.structural and expected != t.last_bell:
+                self._violate(
+                    V_STALE_BELL,
+                    f"{t.tid} waits on bell=={expected} but last loaded "
+                    f"{t.last_bell}")
+                return
+            t.fresh_bell = False
+            self._drain(t.tid)
+            word = self._word(t.tid, LOC_BELL_PEER)
+            current = self.words[word]
+            if current != expected:
+                self.trace.append(
+                    f"{t.tid}: FUTEX_WAIT({word}=={expected}) -> EAGAIN "
+                    f"({word}={current})")
+                self._fetch(t, None)
+            else:
+                t.status = SLEEPING
+                self.sleepers[t.tid] = word
+                self.trace.append(
+                    f"{t.tid}: FUTEX_WAIT({word}=={expected}) -> sleep")
+        else:  # OP_WAKE
+            t.bell_store_pending = False
+            self._drain(t.tid)
+            word = self._word(t.tid, LOC_BELL_OWN)
+            woken = sorted(tid for tid, on in self.sleepers.items()
+                           if on == word)
+            for tid in woken:
+                other = self.threads[tid]
+                other.status = RUNNABLE
+                del self.sleepers[tid]
+                self._fetch(other, None)
+            self.trace.append(
+                f"{t.tid}: FUTEX_WAKE({word}) -> woke {woken}")
+            self._fetch(t, None)
+
+    # -- terminal checks ---------------------------------------------------
+
+    def final_check(self) -> Optional[Violation]:
+        """Invariants judged at quiescence (no enabled actions): every
+        buffered store has flushed, so global memory is the final state."""
+        if self.violation is not None:
+            return self.violation
+        sleeping = [t for t in self.threads.values()
+                    if t.status == SLEEPING]
+        if sleeping:
+            head, tail = self.words[LOC_HEAD], self.words[LOC_TAIL]
+            for t in sleeping:
+                waits_for = (self.scenario.cap - (head - tail)) \
+                    if t.tid == SENDER else (head - tail)
+                if waits_for > 0:
+                    self._violate(
+                        V_MISSED_WAKEUP,
+                        f"{t.tid} is asleep on the bell with "
+                        f"{waits_for} byte(s) of "
+                        f"{'space' if t.tid == SENDER else 'data'} "
+                        "already published and no wake left in flight: "
+                        "production burns a full bounded wait "
+                        "(_BELL_WAIT_SECS) per occurrence")
+                    return self.violation
+            if len(sleeping) == 2:
+                self._violate(V_DEADLOCK,
+                              "both sides asleep on the bell with "
+                              "nothing published either way")
+            else:
+                self._violate(
+                    V_STARVATION,
+                    f"{sleeping[0].tid} asleep with its condition "
+                    "unsatisfiable (peer finished): bytes went missing")
+            return self.violation
+        if not self.abort:
+            total = self.scenario.total_bytes
+            if self.received != list(range(total)):
+                self._violate(
+                    V_LOST_BYTES,
+                    f"delivered {len(self.received)}/{total} bytes "
+                    "(out-of-order or missing) at termination")
+                return self.violation
+            for t in self.threads.values():
+                if t.result != DONE:
+                    self._violate(
+                        V_MODEL_ERROR,
+                        f"{t.tid} ended {t.result!r} with no abort fired")
+                    return self.violation
+        return None

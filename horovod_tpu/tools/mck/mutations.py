@@ -1,0 +1,156 @@
+"""Seeded protocol bugs the checker must catch — the checker's checker.
+
+A model checker that silently explores too little is worse than none:
+it stamps "proved" on unexplored space.  Each mutation here wraps one
+side's REAL step generator and perturbs its op stream into a classic
+lock-free-ring bug; ``hvd-mck --mutants`` (and tests/test_mck.py)
+asserts the exhaustive run kills every one of them with a named
+violation and a minimal reproducing schedule.  If a refactor of the
+explorer or the scenarios ever stops killing a mutant, the bounds got
+too weak — fail the build, don't shrink the claim.
+
+The wrappers sit between the driver and the generator, so the
+production protocol code itself stays untouched: a mutation is "what if
+the protocol did X instead", expressed in the same op vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, FrozenSet
+
+from ...transport.shm import LOC_BELL_PEER, LOC_HEAD, LOC_TAIL, \
+    OP_LOAD, OP_STORE, OP_WAKE
+from .model import RECEIVER, SENDER, V_FUTEX_PAIRING, V_LIVELOCK, \
+    V_LOST_BYTES, V_MISSED_WAKEUP, V_STALE_BELL, V_STARVATION, \
+    V_UNPUBLISHED_READ
+
+
+class Mutation:
+    """One seeded bug: which side it infects, the scenario that best
+    exposes it, and the violation classes that count as a kill."""
+
+    __slots__ = ("name", "role", "scenario", "expected", "description",
+                 "wrap")
+
+    def __init__(self, name: str, role: str, scenario: str,
+                 expected: FrozenSet[str], description: str,
+                 wrap: Callable):
+        self.name = name
+        self.role = role
+        self.scenario = scenario
+        self.expected = expected
+        self.description = description
+        self.wrap = wrap
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "role": self.role,
+                "scenario": self.scenario,
+                "expected": sorted(self.expected),
+                "description": self.description}
+
+
+def _swap_publish_bump(gen):
+    """Publish the head AFTER the doorbell wake instead of before: the
+    woken peer reads a stale head, finds nothing, and goes back to sleep
+    with data already committed — the missed-wakeup the publish-before-
+    bump ordering exists to prevent."""
+    held = []
+    resp = None
+    while True:
+        try:
+            op = gen.send(resp)
+        except StopIteration as fin:
+            for h in held:
+                yield h
+            return fin.value
+        if op[0] == OP_STORE and op[1] in (LOC_HEAD, LOC_TAIL):
+            held.append(op)
+            resp = None
+            continue
+        resp = yield op
+        if op[0] == OP_WAKE:
+            for h in held:
+                yield h
+            held = []
+
+
+def _drop_bell_precheck(gen):
+    """Reuse the first bell read forever instead of re-reading before
+    every wait: a bump between the stale read and FUTEX_WAIT is
+    invisible, so the wait can no longer be cut short — the lost-wakeup
+    window the load-bell-BEFORE-ring-state ordering closes."""
+    cached = None
+    resp = None
+    while True:
+        try:
+            op = gen.send(resp)
+        except StopIteration as fin:
+            return fin.value
+        if op[0] == OP_LOAD and op[1] == LOC_BELL_PEER \
+                and op[2] == "precheck":
+            if cached is None:
+                cached = yield op
+            resp = cached
+            continue
+        resp = yield op
+
+
+def _free_space_off_by_one(gen):
+    """Report the consumer one byte ahead of where it is: free-space
+    comes out one too high, the sender overwrites the oldest unread
+    byte at the wrap seam, and the receiver lands a wrong sequence
+    number — the classic ring off-by-one."""
+    resp = None
+    while True:
+        try:
+            op = gen.send(resp)
+        except StopIteration as fin:
+            return fin.value
+        resp = yield op
+        if op[0] == OP_LOAD and op[1] == LOC_TAIL:
+            resp = resp + 1
+
+
+def _skip_final_wake(gen):
+    """Swallow the FUTEX_WAKE of the final bell bump: the bell moves but
+    no sleeper is ever kicked, so a peer already parked on the old value
+    burns the full bounded wait — a store without its paired wake."""
+    resp = None
+    while True:
+        try:
+            op = gen.send(resp)
+        except StopIteration as fin:
+            return fin.value
+        if op[0] == OP_WAKE and op[1] == "final":
+            resp = None
+            continue
+        resp = yield op
+
+
+MUTATIONS: Dict[str, Mutation] = {m.name: m for m in (
+    Mutation(
+        "swap_publish_bump", role=SENDER, scenario="basic",
+        expected=frozenset({V_MISSED_WAKEUP, V_STARVATION,
+                            V_UNPUBLISHED_READ}),
+        description="head published after the doorbell wake instead of "
+                    "before it",
+        wrap=_swap_publish_bump),
+    Mutation(
+        "drop_bell_precheck", role=RECEIVER, scenario="wrap",
+        expected=frozenset({V_STALE_BELL, V_MISSED_WAKEUP, V_LIVELOCK}),
+        description="bell re-read before each wait replaced by the first "
+                    "read, cached forever",
+        wrap=_drop_bell_precheck),
+    Mutation(
+        "free_space_off_by_one", role=SENDER, scenario="wrap",
+        expected=frozenset({V_LOST_BYTES, V_UNPUBLISHED_READ}),
+        description="free-space computed against tail+1: one unread "
+                    "byte overwritten at the wrap seam",
+        wrap=_free_space_off_by_one),
+    Mutation(
+        "skip_final_wake", role=SENDER, scenario="basic",
+        expected=frozenset({V_FUTEX_PAIRING, V_MISSED_WAKEUP}),
+        description="final bell bump stores the new value but never "
+                    "issues FUTEX_WAKE",
+        wrap=_skip_final_wake),
+)}

@@ -1,0 +1,77 @@
+"""Human and machine rendering of model-checker results.
+
+The text report is what a developer reads when CI goes red: the
+violation class, why it matters in production terms, and the minimal
+reproducing schedule — every scheduled action in order, small enough to
+walk through by hand.  The JSON report is the CI artifact
+(``ci/mck.last.report.json``): schedule counts and completeness per
+scenario, so "proved" is auditable and a truncated run cannot
+impersonate an exhaustive one.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .explore import ExploreResult
+
+
+def render_result(res: ExploreResult) -> str:
+    head = f"{res.scenario.name} [{res.model}"
+    if res.mutation_name:
+        head += f", mutant {res.mutation_name}"
+    head += "]"
+    status = "OK" if res.ok else "VIOLATION"
+    if res.truncated:
+        status += " (TRUNCATED: schedule cap hit, space NOT exhausted)"
+    lines = [
+        f"{head}: {status}",
+        f"  schedules explored: {res.schedules}  "
+        f"max depth: {res.max_depth}  "
+        f"preemption bound: {res.bound}  "
+        f"elapsed: {res.elapsed:.2f}s",
+    ]
+    for viol in res.violations.values():
+        lines.append(f"  {viol.name}: {viol.detail}")
+        if res.min_bound is not None:
+            lines.append(
+                f"  minimal counterexample ({res.min_bound} "
+                f"preemption(s), {len(viol.schedule)} actions):")
+        else:
+            lines.append(
+                f"  counterexample ({len(viol.schedule)} actions):")
+        lines.extend(f"    {step}" for step in viol.schedule)
+    return "\n".join(lines)
+
+
+def render_text(results: List[ExploreResult]) -> str:
+    return "\n".join(render_result(r) for r in results)
+
+
+def summary_line(results: List[ExploreResult]) -> str:
+    scheds = sum(r.schedules for r in results)
+    bad = sorted({name for r in results for name in r.violations})
+    trunc = sum(1 for r in results if r.truncated)
+    verdict = f"violations: {', '.join(bad)}" if bad else "no violations"
+    tail = f"; {trunc} run(s) truncated" if trunc else ""
+    return (f"hvd-mck: {len(results)} run(s), {scheds} schedules — "
+            f"{verdict}{tail}")
+
+
+def to_report_dict(results: List[ExploreResult], mode: str) -> dict:
+    return {
+        "tool": "hvd-mck",
+        "mode": mode,
+        "runs": [r.to_dict() for r in results],
+        "ok": all(r.ok for r in results),
+        "complete": all(r.complete for r in results),
+    }
+
+
+def write_json(results: List[ExploreResult], mode: str,
+               path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_report_dict(results, mode), fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,49 @@
+"""Bounded workloads the checker proves the ring protocol over.
+
+Each scenario pins a ring capacity, a list of per-CALL segment-length
+lists for each side (one generator instance per call — the per-call
+bell discipline is part of the contract under test), whether a mesh
+abort may fire, and the preemption budget the exhaustive run uses.
+Small on purpose: the protocol's state machine has no data-dependent
+branching beyond "is there room / is there data", so capacity-wrap,
+multi-call FIFO, full-ring blocking, and abort-while-blocked between
+them exercise every edge the production ring can take, at depths the
+exhaustive explorer finishes in seconds.
+
+The preemption budgets are one above where each scenario's search space
+stops yielding new behavior classes — and the mutation-kill suite
+(tests/test_mck.py) demonstrates every seeded bug is caught within
+them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .model import Scenario
+
+SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
+    Scenario(
+        "basic", cap=8, send_calls=[[2]], recv_calls=[[2]], abort=False,
+        description="one small segment, no wraparound: doorbell "
+                    "handshake and final-bump pairing in isolation",
+        preemptions=3),
+    Scenario(
+        "wrap", cap=2, send_calls=[[3]], recv_calls=[[3]], abort=False,
+        description="3 bytes through a 2-byte ring: position wraparound, "
+                    "free-space math at the seam, full-ring sender waits",
+        preemptions=3),
+    Scenario(
+        "frames", cap=2, send_calls=[[1], [2]], recv_calls=[[1], [2]],
+        abort=False,
+        description="two back-to-back calls per side (second wraps): "
+                    "per-call bell bump discipline and FIFO across "
+                    "call boundaries",
+        preemptions=2),
+    Scenario(
+        "abort", cap=2, send_calls=[[3]], recv_calls=[[3]], abort=True,
+        description="mesh abort may fire at any point, including with "
+                    "the sender blocked on a full ring: bounded-wait "
+                    "abort reachability, no abandoned sleeper",
+        preemptions=2),
+)}

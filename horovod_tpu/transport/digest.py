@@ -34,6 +34,11 @@ import zlib
 import numpy as np
 
 from ..common.exceptions import HorovodInternalError
+from .frame_bits import _DIGEST_PAYLOAD
+
+#: Byte size of a digest-check frame's payload — transports validate the
+#: claimed frame size against this before unpacking.
+CHECK_SIZE = _DIGEST_PAYLOAD.size
 
 _MASK64 = (1 << 64) - 1
 # Golden-ratio odd constant (splitmix64's increment): whitens the word
@@ -60,6 +65,18 @@ def algo_from_name(name: str) -> int:
 
 def algo_name(algo: int) -> str:
     return _NAME_BY_ALGO.get(algo, f"algo#{algo}")
+
+
+def pack_check(dig: "StreamDigest", frames: int) -> bytes:
+    """Serialize the digest-check frame payload closing a ring step:
+    ``<B algo><Q chained digest><Q frame count>``.  Both transports emit
+    it through here so the check-frame layout cannot fork."""
+    return _DIGEST_PAYLOAD.pack(dig.algo, dig.value(), frames)
+
+
+def unpack_check(payload) -> "tuple[int, int, int]":
+    """Decode a digest-check payload into ``(algo, value, frames)``."""
+    return _DIGEST_PAYLOAD.unpack(payload)
 
 
 def _fold64(view: memoryview) -> int:

@@ -1,0 +1,76 @@
+"""Frame-header bit registry — the wire contract's single source of truth.
+
+Every transport frames payloads the same way: ``<Q len|flags>[<I crc32>]``
++ payload — an 8-byte little-endian length word whose top bits carry the
+frame flags, optionally followed by a 4-byte CRC32 of the payload, then
+the payload bytes.  The flag bits, dtype lane, and header structs are
+defined HERE and only here; ``tcp.py``, ``shm.py``, ``digest.py`` and
+every fixture import them.  hvd-lint rule HVD008 enforces the split: a
+``1 << 56``..``1 << 63`` literal or a re-definition of any of these
+names outside this module is a lint error, because two transports
+re-deriving the same bit positions is exactly how framing contracts
+drift apart (the pre-extraction state: ``tcp.py`` owned the bits and
+``shm.py`` re-imported some while re-deriving the rest).  HVD005 checks
+the contract VALUES in this module — the bit positions and struct
+formats the docs and mixed-version analysis depend on.
+
+Layout recap (full story in ``tcp.py``'s module docstring and
+docs/data_plane.md):
+
+- bit 63 ``_CTRL_FLAG`` — control frame (coordinated abort).  In-band
+  marking keeps control ordered with data on the same stream; no payload
+  is ever 2^63 bytes long, so the bit is unambiguous.
+- bit 62 ``_DEFER_FLAG`` — digest-DEFERRED data frame: no inline CRC
+  field follows; the payload is covered by the ring step's chained
+  shadow digest instead (``transport/digest.py``).
+- bit 61 ``_DIGEST_FLAG`` — the digest-check frame closing a deferred
+  ring step (``<B algo><Q digest><Q frames>`` payload, always
+  inline-CRC'd — it IS the verification).
+- bits 56-58 ``_WIRE_DTYPE_MASK`` — wire dtype code stamped by
+  cast-on-the-wire compression (``backend/compression.py``), so
+  compression-config skew between peers is a loud poisoned-stream
+  abort, not silent garbage.
+
+A pre-flags peer masks only bit 63, reads any flagged frame as an
+absurd length, and aborts on the frame-size cap — mixed-version meshes
+fail loudly by construction.
+"""
+
+from __future__ import annotations
+
+import collections
+import struct
+
+_LEN = struct.Struct("<Q")
+# Wire CRC field (HOROVOD_WIRE_CRC, default on): crc32(payload) follows
+# the length word, so the full frame header is <Q len|flags><I crc32>.
+# Control frames carry it too — one header layout, no per-frame-kind
+# branches.  The CRC is CORRUPTION detection, not authentication
+# (docs/security.md); a mismatch is unrecoverable by design because
+# positional framing after a bad frame cannot be trusted.
+_CRC = struct.Struct("<I")
+_CTRL_FLAG = 1 << 63
+_DEFER_FLAG = 1 << 62
+_DIGEST_FLAG = 1 << 61
+_WIRE_DTYPE_SHIFT = 56
+_WIRE_DTYPE_MASK = 0x7 << _WIRE_DTYPE_SHIFT
+# All header flag bits — everything that is not payload length.
+_FLAGS_MASK = _CTRL_FLAG | _DEFER_FLAG | _DIGEST_FLAG | _WIRE_DTYPE_MASK
+# Digest-check frame payload: digest algorithm code, 64-bit chained
+# digest, frame count for the step it closes.
+_DIGEST_PAYLOAD = struct.Struct("<BQQ")
+
+#: Decoded frame header: ``crc`` is None when the mesh CRC is off or the
+#: frame is digest-deferred.
+_FrameHeader = collections.namedtuple(
+    "_FrameHeader", ("ctrl", "deferred", "check", "wire_dtype", "size", "crc"))
+
+# Sanity cap on a frame's claimed payload size.  The length word itself
+# is not CRC-covered, and a flipped HIGH byte claims terabytes: recv
+# would allocate that buffer BEFORE any CRC or deadline could catch it
+# (MemoryError or the OOM killer, not a coordinated abort).  Real frames
+# are bounded by the fusion buffer (64 MB default) plus allgather
+# fan-in — orders of magnitude under this cap — so an oversized claim is
+# treated exactly like a CRC mismatch: poisoned stream, coordinated
+# abort.
+_MAX_FRAME_BYTES = 1 << 32  # 4 GiB

@@ -14,8 +14,9 @@ the steady-state path.
 
 Frame discipline is IDENTICAL to ``transport/tcp.py`` — the same
 ``<Q len|flags>[<I crc32>]`` header, the same control/deferred/digest-
-check/wire-dtype flag bits (imported from tcp.py, the single owner of the
-wire constants), the same poisoned-stream and coordinated-abort
+check/wire-dtype flag bits (imported from ``transport/frame_bits.py``,
+the registry that owns the wire constants; HVD008), the same
+poisoned-stream and coordinated-abort
 semantics, the same progress deadline (reusing
 ``HOROVOD_TCP_PROGRESS_DEADLINE_SECS`` so the failure plane has ONE knob,
 not one per transport).  The only intentional difference:
@@ -75,21 +76,22 @@ from ..common.exceptions import (
 )
 from ..common.logging_util import get_logger
 from ..core import flight_recorder, metrics
-from .store import Store
-from .tcp import (
-    _ABORT_POLL_SECS,
+from .frame_bits import (
     _CRC,
     _CTRL_FLAG,
     _DEFER_FLAG,
     _DIGEST_FLAG,
-    _DIGEST_PAYLOAD,
     _FLAGS_MASK,
     _FrameHeader,
     _LEN,
     _MAX_FRAME_BYTES,
-    _ProgressStall,
     _WIRE_DTYPE_MASK,
     _WIRE_DTYPE_SHIFT,
+)
+from .store import Store
+from .tcp import (
+    _ABORT_POLL_SECS,
+    _ProgressStall,
     AbortState,
     PendingRecv,
     _as_byte_view,
@@ -104,7 +106,11 @@ log = get_logger("horovod_tpu.transport.shm")
 SEG_PREFIX = "hvdshm-"
 
 _SHM_MAGIC = 0x48565348  # "HVSH"
-_SHM_VERSION = 1
+# v2: the per-direction doorbell split into two single-writer bells
+# (data bell / space bell) after hvd-mck exhibited an ABA lost-update on
+# the shared-bell layout — see the doorbell comment below.  Version skew
+# fails loudly at attach, like every other layout change.
+_SHM_VERSION = 2
 
 # Segment header layout (little-endian).  Direction counters sit 64 bytes
 # apart so the two writers never share a cache line.
@@ -117,26 +123,50 @@ _OFF_L2H_HEAD = 64      # u64 lower→higher bytes written (lower owns)
 _OFF_L2H_TAIL = 128     # u64 lower→higher bytes read (higher owns)
 _OFF_H2L_HEAD = 192     # u64 higher→lower bytes written (higher owns)
 _OFF_H2L_TAIL = 256     # u64 higher→lower bytes read (lower owns)
-_OFF_L2H_BELL = 288     # u32 doorbell: bumped by EITHER end's L2H store
-_OFF_H2L_BELL = 296     # u32 doorbell: bumped by EITHER end's H2L store
+# Four doorbells, ONE WRITER EACH (see the doorbell comment below for
+# why the shared-bell layout was an ABA bug): a direction's data bell is
+# bumped only by its sender (waking a receiver out of data), its space
+# bell only by its receiver (waking a sender out of ring space).
+_OFF_L2H_DATA_BELL = 288   # u32: bumped by lower (L2H sender) only
+_OFF_L2H_SPACE_BELL = 296  # u32: bumped by higher (L2H receiver) only
+_OFF_H2L_DATA_BELL = 304   # u32: bumped by higher (H2L sender) only
+_OFF_H2L_SPACE_BELL = 312  # u32: bumped by lower (H2L receiver) only
 _RINGS_OFF = 320        # L2H ring, then H2L ring at +capacity
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
-# Blocked ring waits sleep on a FUTEX DOORBELL: each direction carries a
-# u32 bell that either end bumps (with a FUTEX_WAKE) after publishing a
-# head or tail advance, and a rank out of data/space does a kernel
-# FUTEX_WAIT on (bell == value-seen-before-checking).  That gives shm
-# the property the TCP path gets from blocking sockets — the waiter
-# wakes the instant bytes (or space) land, with zero polling — which is
-# what lets shm beat loopback TCP on wakeup latency instead of losing
-# every blocked wait to a poll quantum.  The wait is still bounded
-# (_BELL_WAIT_SECS) so the abort flag and the peer-PID probe keep their
-# poll cadence, and the bump-after-store protocol makes lost wakeups
-# impossible: a store is visible before its bump (x86-64 TSO), so a
-# waiter either sees the progress or sees a moved bell and returns
-# immediately.  Where the futex syscall is unavailable (non-Linux,
+# Blocked ring waits sleep on a FUTEX DOORBELL: each direction carries
+# two u32 bells, each with exactly ONE writer — the sender bumps the
+# data bell (with a FUTEX_WAKE) after publishing head advances, the
+# receiver bumps the space bell after publishing tail advances — and a
+# rank out of data/space does a kernel FUTEX_WAIT on (peer's bell ==
+# value-seen-before-checking).  That gives shm the property the TCP path
+# gets from blocking sockets — the waiter wakes the instant bytes (or
+# space) land, with zero polling — which is what lets shm beat loopback
+# TCP on wakeup latency instead of losing every blocked wait to a poll
+# quantum.  The wait is still bounded (_BELL_WAIT_SECS) so the abort
+# flag and the peer-PID probe keep their poll cadence, and the
+# bump-after-store protocol makes lost wakeups impossible: a store is
+# visible before its bump (x86-64 TSO), so a waiter either sees the
+# progress or sees a moved bell and returns immediately.  That claim is
+# no longer prose-only: `hvd-mck` explores every bounded interleaving of
+# sender_steps/receiver_steps below and proves it under a TSO
+# store-buffer model — and exhibits the missed wakeup under a weaker
+# model, so the fence the protocol leans on is a machine-checked fact
+# (tools/mck; docs/static_analysis.md).
+#
+# Why one writer per bell: v1 had a single bell per direction that BOTH
+# ends incremented with a plain load+store (no atomic RMW exists for a
+# Python shm buffer).  hvd-mck found the resulting ABA the first time it
+# ran: one end's increment, delayed in its store buffer (or just
+# preempted between load and store), lands late, clobbers the other
+# end's bumps, and can restore the exact value a waiter is about to
+# FUTEX_WAIT on — the waiter sleeps a full bounded wait with its data
+# already published.  Splitting the bell by writer makes the lost update
+# structurally impossible: an increment is a data race only if the word
+# has a second writer.
+# Where the futex syscall is unavailable (non-Linux,
 # unknown arch), waits fall back to a two-phase nap ramp: ~one scheduler
 # tick for the first _RING_NAP_RAMP polls, then the long nap so a rank
 # stalled across a whole negotiation naps instead of spinning.
@@ -190,12 +220,184 @@ def _futex_wake(addr: int) -> None:
 _MIN_RING_BYTES = 4096
 
 
+# Control-word accessors — the ONLY code allowed to move raw structs
+# against the header offsets (hvd-lint HVD009).  Every head/tail load and
+# store, every bell read and write, and the magic/version words go
+# through these four functions, so the set of shared-memory accesses the
+# model checker must consider is closed by construction.
 def _load_u64(buf, off: int) -> int:
     return _U64.unpack_from(buf, off)[0]
 
 
 def _store_u64(buf, off: int, value: int) -> None:
     _U64.pack_into(buf, off, value)
+
+
+def _load_u32(buf, off: int) -> int:
+    return _U32.unpack_from(buf, off)[0]
+
+
+def _store_u32(buf, off: int, value: int) -> None:
+    _U32.pack_into(buf, off, value)
+
+
+# -- ring protocol kernel (model-checked; see tools/mck) ----------------------
+#
+# The SPSC ring-advance logic is written ONCE, as pure generators over an
+# abstract memory: every shared-memory access is one yielded op tuple, in
+# exact program order, and the caller (the "driver") executes it against
+# real segment memory — or, under ``hvd-mck``, against a model memory
+# with an explicit store-buffer semantics.  The model-checked code IS the
+# production code; there is no second copy to drift (the pre-extraction
+# tree had exactly that bug: ``_abort_write`` re-derived the send run
+# with a diverging per-RUN bell discipline).
+#
+# Op vocabulary (first element is the kind; the driver answers loads and
+# polls through ``generator.send``):
+#
+#   (OP_POLL,)                   -> SIG_OK | SIG_ABORT   abort-flag check
+#   (OP_LOAD, loc, tag)          -> int                  read a control word
+#   (OP_STORE, loc, value[, tag])                        write a control word
+#   (OP_COPY, idx, off, pos, run)                        move run bytes
+#                                   segment idx [off:off+run] <-> ring
+#                                   [pos:pos+run] (direction is the
+#                                   driver's; this op publishes nothing)
+#   (OP_WAIT, expected)                                  bounded sleep until
+#                                   the peer's bell moves off ``expected``
+#   (OP_WAKE, tag)                                       FUTEX_WAKE own bell
+#
+# ``loc`` is LOC_HEAD / LOC_TAIL / LOC_BELL_OWN / LOC_BELL_PEER, always
+# the DIRECTION'S words (the sender's head is the receiver's head).  The
+# two bell locs are role-relative: LOC_BELL_OWN is the single-writer
+# bell this role bumps (the sender's data bell, the receiver's space
+# bell), LOC_BELL_PEER the one it prechecks and waits on.  ``tag``
+# labels bell traffic for the checker ("precheck", "prewait", "final",
+# "abort"); production drivers ignore it.  The generator returns DONE or
+# ABORTED.
+
+OP_POLL = "poll"
+OP_LOAD = "load"
+OP_STORE = "store"
+OP_COPY = "copy"
+OP_WAIT = "wait"
+OP_WAKE = "wake"
+
+LOC_HEAD = "head"
+LOC_TAIL = "tail"
+LOC_BELL_OWN = "own_bell"
+LOC_BELL_PEER = "peer_bell"
+
+SIG_OK = "ok"
+SIG_ABORT = "abort"
+
+DONE = "done"
+ABORTED = "aborted"
+
+
+def bell_bump_steps(tag: str):
+    """Publish pending head/tail advances on this role's doorbell: move
+    the bell and wake its futex waiters.  The increment is a plain
+    load+store — safe ONLY because each bell has one writer (this role),
+    so the RMW can never race another increment.  hvd-mck caught the v1
+    layout, where both ends bumped one shared bell, losing updates and
+    ABA-ing a waiter to sleep; the single-writer split is what makes
+    this non-atomic bump correct, and the checker now proves it."""
+    bell = yield (OP_LOAD, LOC_BELL_OWN, tag)
+    yield (OP_STORE, LOC_BELL_OWN, (bell + 1) & 0xFFFFFFFF, tag)
+    yield (OP_WAKE, tag)
+
+
+def sender_steps(cap: int, lens: List[int]):
+    """Write ``sum(lens)`` bytes (the segments' concatenation) into the
+    ring, chunking at ring-wrap and ring-full boundaries.
+
+    Data bytes land (OP_COPY) strictly BEFORE the head store that
+    publishes them — under CPython's bytecode ordering plus x86-64 TSO an
+    aligned 8-byte store is atomic and never reordered before the data
+    writes it covers, which is the entirety of the memory model this
+    relies on, and ``hvd-mck`` checks exactly that claim: the ``tso``
+    model proves the protocol, the ``weak`` model (store-store
+    reordering allowed) finds the missed wakeup.
+
+    The bell is bumped once per CALL, not per run: each wake is a
+    syscall plus a scheduler event, and on a timeshared core every extra
+    wake is another chance to lose the CPU mid-frame.  The exception is
+    going to sleep with unpublished advances — the peer may be asleep
+    waiting for exactly those bytes, so the bump is published first
+    (publish-before-sleep)."""
+    pending = False  # head advances not yet published on the bell
+    for idx, n in enumerate(lens):
+        off = 0
+        while off < n:
+            if (yield (OP_POLL,)) == SIG_ABORT:
+                if pending:
+                    yield from bell_bump_steps("abort")
+                return ABORTED
+            # Space-bell load FIRST, ring state second: if the peer
+            # frees space and bumps between these two loads, the futex
+            # sees a stale expected value and returns immediately
+            # (EAGAIN).
+            bell = yield (OP_LOAD, LOC_BELL_PEER, "precheck")
+            head = yield (OP_LOAD, LOC_HEAD, None)
+            free = cap - (head - (yield (OP_LOAD, LOC_TAIL, None)))
+            if free == 0:
+                # Publish deferred advances before sleeping — the
+                # peer may be asleep waiting for exactly those bytes.
+                if pending:
+                    yield from bell_bump_steps("prewait")
+                    pending = False
+                    continue
+                yield (OP_WAIT, bell)
+                continue
+            pos = head % cap
+            run = min(n - off, free, cap - pos)
+            yield (OP_COPY, idx, off, pos, run)
+            yield (OP_STORE, LOC_HEAD, head + run)
+            pending = True
+            off += run
+    if pending:
+        yield from bell_bump_steps("final")
+    return DONE
+
+
+def receiver_steps(cap: int, lens: List[int]):
+    """Read ``sum(lens)`` bytes out of the ring into the segments'
+    concatenation — the mirror of :func:`sender_steps` with tail in the
+    writer role: the copy out of the ring happens strictly BEFORE the
+    tail store that frees the span (the sender may overwrite those bytes
+    the moment the tail moves), and the bell discipline is identical
+    (one bump per call, publish-before-sleep)."""
+    pending = False  # tail advances not yet published on the bell
+    for idx, n in enumerate(lens):
+        got = 0
+        while got < n:
+            if (yield (OP_POLL,)) == SIG_ABORT:
+                if pending:
+                    yield from bell_bump_steps("abort")
+                return ABORTED
+            # Same load order as the send side: the peer's (data) bell
+            # first, ring state second.
+            bell = yield (OP_LOAD, LOC_BELL_PEER, "precheck")
+            tail = yield (OP_LOAD, LOC_TAIL, None)
+            avail = (yield (OP_LOAD, LOC_HEAD, None)) - tail
+            if avail == 0:
+                # Publish deferred drains before sleeping — the peer may
+                # be asleep waiting for exactly that ring space.
+                if pending:
+                    yield from bell_bump_steps("prewait")
+                    pending = False
+                    continue
+                yield (OP_WAIT, bell)
+                continue
+            pos = tail % cap
+            run = min(n - got, avail, cap - pos)
+            yield (OP_COPY, idx, got, pos, run)
+            yield (OP_STORE, LOC_TAIL, tail + run)
+            pending = True
+            got += run
+    if pending:
+        yield from bell_bump_steps("final")
+    return DONE
 
 
 def segment_size(ring_bytes: int) -> int:
@@ -232,7 +434,8 @@ class _ShmPeer:
 
     __slots__ = ("shm", "created", "cap", "out_ring", "in_ring",
                  "out_head_off", "out_tail_off", "in_head_off",
-                 "in_tail_off", "out_bell_off", "in_bell_off",
+                 "in_tail_off", "out_data_bell_off", "out_space_bell_off",
+                 "in_data_bell_off", "in_space_bell_off",
                  "base_addr", "addr_anchor", "peer_pid_off",
                  "send_lock", "recv_lock", "dead", "ever_received",
                  "frames_in")
@@ -248,8 +451,12 @@ class _ShmPeer:
             self.out_tail_off = _OFF_L2H_TAIL
             self.in_head_off = _OFF_H2L_HEAD
             self.in_tail_off = _OFF_H2L_TAIL
-            self.out_bell_off = _OFF_L2H_BELL
-            self.in_bell_off = _OFF_H2L_BELL
+            # Sending L2H: I bump its data bell, wait on its space bell;
+            # receiving H2L: I wait on its data bell, bump its space bell.
+            self.out_data_bell_off = _OFF_L2H_DATA_BELL
+            self.out_space_bell_off = _OFF_L2H_SPACE_BELL
+            self.in_data_bell_off = _OFF_H2L_DATA_BELL
+            self.in_space_bell_off = _OFF_H2L_SPACE_BELL
             self.out_ring = buf[_RINGS_OFF:_RINGS_OFF + cap]
             self.in_ring = buf[_RINGS_OFF + cap:_RINGS_OFF + 2 * cap]
             self.peer_pid_off = _OFF_ATTACHER_PID
@@ -258,8 +465,10 @@ class _ShmPeer:
             self.out_tail_off = _OFF_H2L_TAIL
             self.in_head_off = _OFF_L2H_HEAD
             self.in_tail_off = _OFF_L2H_TAIL
-            self.out_bell_off = _OFF_H2L_BELL
-            self.in_bell_off = _OFF_L2H_BELL
+            self.out_data_bell_off = _OFF_H2L_DATA_BELL
+            self.out_space_bell_off = _OFF_H2L_SPACE_BELL
+            self.in_data_bell_off = _OFF_L2H_DATA_BELL
+            self.in_space_bell_off = _OFF_L2H_SPACE_BELL
             self.out_ring = buf[_RINGS_OFF + cap:_RINGS_OFF + 2 * cap]
             self.in_ring = buf[_RINGS_OFF:_RINGS_OFF + cap]
             self.peer_pid_off = _OFF_CREATOR_PID
@@ -281,14 +490,10 @@ class _ShmPeer:
         self.ever_received = False
         self.frames_in = 0
 
-    def bump_bell(self, off: int) -> None:
-        """Publish a head/tail advance: move the direction's bell and
-        wake its futex waiters.  The two ends may race this non-atomic
-        increment and collapse two bumps into one — harmless, a waiter
-        keys on the VALUE changing, not on the count."""
-        buf = self.shm.buf
-        _U32.pack_into(buf, off,
-                       (_U32.unpack_from(buf, off)[0] + 1) & 0xFFFFFFFF)
+    def wake(self, off: int) -> None:
+        """FUTEX_WAKE the direction's bell waiters (the OP_WAKE half of
+        :func:`bell_bump_steps` — the bell increment itself is a plain
+        OP_STORE the driver already executed)."""
         if self.base_addr:
             _futex_wake(self.base_addr + off)
             # FUTEX_WAKE has no sync-wakeup hint (the thing a loopback
@@ -389,8 +594,8 @@ class ShmMesh:
         # Header before publish: an attacher never sees a half-built
         # segment.  /dev/shm segments are born zero-filled, so the ring
         # counters and the attacher-PID slot start correct for free.
-        _U32.pack_into(buf, _OFF_MAGIC, _SHM_MAGIC)
-        _U32.pack_into(buf, _OFF_VERSION, _SHM_VERSION)
+        _store_u32(buf, _OFF_MAGIC, _SHM_MAGIC)
+        _store_u32(buf, _OFF_VERSION, _SHM_VERSION)
         _store_u64(buf, _OFF_CAP, cap)
         _store_u64(buf, _OFF_CREATOR_PID, os.getpid())
         store.set(scope, key, seg.name.encode())
@@ -411,8 +616,8 @@ class ShmMesh:
             log.warning("could not unregister shm attach from the resource "
                         "tracker; exit may unlink %s early", name)
         buf = seg.buf
-        magic = _U32.unpack_from(buf, _OFF_MAGIC)[0]
-        version = _U32.unpack_from(buf, _OFF_VERSION)[0]
+        magic = _load_u32(buf, _OFF_MAGIC)
+        version = _load_u32(buf, _OFF_VERSION)
         if magic != _SHM_MAGIC or version != _SHM_VERSION:
             seg.close()
             raise HorovodInternalError(
@@ -499,79 +704,94 @@ class ShmMesh:
     # -- ring I/O -----------------------------------------------------------
 
     def _send_bounded(self, p: _ShmPeer, bufs: List[memoryview],
-                      budget: Optional[float] = None) -> None:
-        """Copy ``bufs`` into the outbound ring, chunking at ring-wrap and
-        ring-full boundaries.  Data bytes land BEFORE the head store that
-        publishes them (module docstring's memory model).  Same failure
-        waits as the TCP send: abort flag every wakeup, progress deadline
-        on zero byte progress, peer-PID probe while stalled."""
+                      budget: Optional[float] = None,
+                      ignore_abort: bool = False) -> None:
+        """Copy ``bufs`` into the outbound ring by driving the pure
+        :func:`sender_steps` protocol against the live segment — ring
+        math, bell discipline, and memory-access ORDER all come from the
+        generator (the model-checked code path); this driver only
+        executes the ops and supplies the failure plane: abort flag on
+        every poll, progress deadline on zero byte progress, peer-PID
+        probe while stalled.
+
+        ``ignore_abort=True`` is the abort-broadcast variant (the frame
+        being written IS the abort — the flag is already set and the
+        normal path would refuse to write): polls never report the
+        abort, the first stalled wait probes the peer immediately, and
+        blocked waits plain-sleep (the nap Event is already set on this
+        path, so only a real sleep yields)."""
         buf = p.shm.buf
-        cap = p.cap
         budget = self.progress_deadline if budget is None else budget
         deadline = (time.monotonic() + budget) if budget > 0 else None
-        next_probe = time.monotonic() + _ABORT_POLL_SECS
+        next_probe = 0.0 if ignore_abort \
+            else time.monotonic() + _ABORT_POLL_SECS
         naps = 0
-        pending = False  # head advances not yet published on the bell
-        for b in bufs:
-            n = len(b)
-            off = 0
-            while off < n:
-                if self._abort is not None:
-                    if pending:
-                        p.bump_bell(p.out_bell_off)
-                    raise CoordinatedAbortError(*self._abort)
-                # Bell load FIRST, ring state second: if the peer frees
-                # space and bumps between these two loads, the futex sees
-                # a stale expected value and returns immediately (EAGAIN).
-                bell = _U32.unpack_from(buf, p.out_bell_off)[0]
-                head = _load_u64(buf, p.out_head_off)
-                free = cap - (head - _load_u64(buf, p.out_tail_off))
-                if free == 0:
-                    # Publish deferred advances before sleeping — the
-                    # peer may be asleep waiting for exactly those bytes.
-                    if pending:
-                        p.bump_bell(p.out_bell_off)
-                        pending = False
-                        continue
-                    now = time.monotonic()
-                    if deadline is not None and now > deadline:
-                        raise _ProgressStall(
-                            f"no send progress for {budget:.0f}s "
-                            f"(HOROVOD_TCP_PROGRESS_DEADLINE_SECS="
-                            f"{budget:g}, shm ring full)")
-                    if now >= next_probe:
-                        self._require_peer_alive(p)
-                        next_probe = now + _ABORT_POLL_SECS
-                    naps = p.bell_wait(p.out_bell_off, bell, naps,
-                                       self._nap)
-                    continue
-                pos = head % cap
-                run = min(n - off, free, cap - pos)
-                p.out_ring[pos:pos + run] = b[off:off + run]
-                _store_u64(buf, p.out_head_off, head + run)
-                # One bump per CALL, not per run: each wake is a syscall
-                # plus a scheduler event, and on a timeshared core every
-                # extra wake is another chance to lose the CPU mid-frame.
-                pending = True
-                off += run
+        steps = sender_steps(p.cap, [len(b) for b in bufs])
+        resp = None
+        while True:
+            try:
+                op = steps.send(resp)
+            except StopIteration as fin:
+                if fin.value == ABORTED:
+                    raise CoordinatedAbortError(*self._abort) from None
+                return
+            kind = op[0]
+            resp = None
+            if kind == OP_LOAD:
+                if op[1] == LOC_BELL_PEER:
+                    resp = _load_u32(buf, p.out_space_bell_off)
+                elif op[1] == LOC_BELL_OWN:
+                    resp = _load_u32(buf, p.out_data_bell_off)
+                elif op[1] == LOC_HEAD:
+                    resp = _load_u64(buf, p.out_head_off)
+                else:
+                    resp = _load_u64(buf, p.out_tail_off)
+            elif kind == OP_COPY:
+                _, idx, off, pos, run = op
+                p.out_ring[pos:pos + run] = bufs[idx][off:off + run]
                 naps = 0
                 if deadline is not None:
                     deadline = time.monotonic() + budget
-                next_probe = time.monotonic() + _ABORT_POLL_SECS
-        if pending:
-            p.bump_bell(p.out_bell_off)
+                if not ignore_abort:
+                    next_probe = time.monotonic() + _ABORT_POLL_SECS
+            elif kind == OP_STORE:
+                if op[1] == LOC_BELL_OWN:
+                    _store_u32(buf, p.out_data_bell_off, op[2])
+                else:
+                    _store_u64(buf, p.out_head_off, op[2])
+            elif kind == OP_WAKE:
+                p.wake(p.out_data_bell_off)
+            elif kind == OP_POLL:
+                resp = SIG_ABORT if not ignore_abort \
+                    and self._abort is not None else SIG_OK
+            else:  # OP_WAIT — ring full
+                now = time.monotonic()
+                if deadline is not None and now > deadline:
+                    raise _ProgressStall(
+                        "shm ring full while broadcasting abort"
+                        if ignore_abort else
+                        f"no send progress for {budget:.0f}s "
+                        f"(HOROVOD_TCP_PROGRESS_DEADLINE_SECS="
+                        f"{budget:g}, shm ring full)")
+                if now >= next_probe:
+                    self._require_peer_alive(p)
+                    next_probe = now + _ABORT_POLL_SECS
+                if ignore_abort:
+                    time.sleep(_RING_NAP_SECS)  # hvdlint: disable=HVD001 -- bounded by the abort-broadcast deadline above
+                else:
+                    naps = p.bell_wait(p.out_space_bell_off, op[1], naps,
+                                       self._nap)
 
     def _recv_bounded_into(self, p: _ShmPeer, view: memoryview,
                            with_crc: bool) -> Optional[int]:
         """Copy exactly ``len(view)`` bytes out of the inbound ring into
-        the caller's view, folding CRC32 over each landed span when asked
-        — the incremental-CRC half of the zero-copy contract, same as the
-        TCP side.  The deadline arms only after the peer's first-ever
-        bytes (bring-up stagger is the startup timeout's problem)."""
+        the caller's view by driving the pure :func:`receiver_steps`
+        protocol (see ``_send_bounded`` — same driver split), folding
+        CRC32 over each landed span when asked — the incremental-CRC half
+        of the zero-copy contract, same as the TCP side.  The deadline
+        arms only after the peer's first-ever bytes (bring-up stagger is
+        the startup timeout's problem)."""
         buf = p.shm.buf
-        cap = p.cap
-        n = len(view)
-        got = 0
         crc = 0
         measure_crc = with_crc and metrics.ENABLED
         crc_secs = 0.0
@@ -580,22 +800,57 @@ class ShmMesh:
             if budget > 0 and p.ever_received else None
         next_probe = time.monotonic() + _ABORT_POLL_SECS
         naps = 0
-        pending = False  # tail advances not yet published on the bell
-        while got < n:
-            if self._abort is not None:
-                if pending:
-                    p.bump_bell(p.in_bell_off)
-                raise CoordinatedAbortError(*self._abort)
-            bell = _U32.unpack_from(buf, p.in_bell_off)[0]
-            tail = _load_u64(buf, p.in_tail_off)
-            avail = _load_u64(buf, p.in_head_off) - tail
-            if avail == 0:
-                # Publish deferred drains before sleeping — the peer may
-                # be asleep waiting for exactly that ring space.
-                if pending:
-                    p.bump_bell(p.in_bell_off)
-                    pending = False
-                    continue
+        steps = receiver_steps(p.cap, [len(view)])
+        resp = None
+        while True:
+            try:
+                op = steps.send(resp)
+            except StopIteration as fin:
+                if fin.value == ABORTED:
+                    raise CoordinatedAbortError(*self._abort) from None
+                break
+            kind = op[0]
+            resp = None
+            if kind == OP_LOAD:
+                if op[1] == LOC_BELL_PEER:
+                    resp = _load_u32(buf, p.in_data_bell_off)
+                elif op[1] == LOC_BELL_OWN:
+                    resp = _load_u32(buf, p.in_space_bell_off)
+                elif op[1] == LOC_HEAD:
+                    resp = _load_u64(buf, p.in_head_off)
+                else:
+                    resp = _load_u64(buf, p.in_tail_off)
+            elif kind == OP_COPY:
+                # Copy (and CRC) BEFORE the tail store the generator
+                # yields next — the sender may overwrite the span the
+                # moment the tail moves.
+                _, _idx, got, pos, run = op
+                naps = 0
+                view[got:got + run] = p.in_ring[pos:pos + run]
+                if with_crc:
+                    if measure_crc:
+                        tc = time.perf_counter()
+                        crc = zlib.crc32(view[got:got + run], crc)
+                        crc_secs += time.perf_counter() - tc
+                    else:
+                        crc = zlib.crc32(view[got:got + run], crc)
+                if not p.ever_received:
+                    p.ever_received = True
+                    if budget > 0:
+                        deadline = time.monotonic() + budget
+                elif deadline is not None:
+                    deadline = time.monotonic() + budget
+                next_probe = time.monotonic() + _ABORT_POLL_SECS
+            elif kind == OP_STORE:
+                if op[1] == LOC_BELL_OWN:
+                    _store_u32(buf, p.in_space_bell_off, op[2])
+                else:
+                    _store_u64(buf, p.in_tail_off, op[2])
+            elif kind == OP_WAKE:
+                p.wake(p.in_space_bell_off)
+            elif kind == OP_POLL:
+                resp = SIG_ABORT if self._abort is not None else SIG_OK
+            else:  # OP_WAIT — ring empty
                 now = time.monotonic()
                 if deadline is not None and now > deadline:
                     raise _ProgressStall(
@@ -604,33 +859,8 @@ class ShmMesh:
                 if now >= next_probe:
                     self._require_peer_alive(p)
                     next_probe = now + _ABORT_POLL_SECS
-                naps = p.bell_wait(p.in_bell_off, bell, naps, self._nap)
-                continue
-            pos = tail % cap
-            run = min(n - got, avail, cap - pos)
-            naps = 0
-            view[got:got + run] = p.in_ring[pos:pos + run]
-            if with_crc:
-                if measure_crc:
-                    tc = time.perf_counter()
-                    crc = zlib.crc32(view[got:got + run], crc)
-                    crc_secs += time.perf_counter() - tc
-                else:
-                    crc = zlib.crc32(view[got:got + run], crc)
-            _store_u64(buf, p.in_tail_off, tail + run)
-            # One bump per CALL (see _send_bounded): fewer wake syscalls,
-            # fewer chances to lose the timeshared core mid-frame.
-            pending = True
-            got += run
-            if not p.ever_received:
-                p.ever_received = True
-                if budget > 0:
-                    deadline = time.monotonic() + budget
-            elif deadline is not None:
-                deadline = time.monotonic() + budget
-            next_probe = time.monotonic() + _ABORT_POLL_SECS
-        if pending:
-            p.bump_bell(p.in_bell_off)
+                naps = p.bell_wait(p.in_data_bell_off, op[1], naps,
+                                   self._nap)
         if measure_crc and crc_secs:
             metrics.inc("crc_verify_seconds_total", crc_secs)
         return (crc & 0xFFFFFFFF) if with_crc else None
@@ -826,8 +1056,7 @@ class ShmMesh:
 
     def send_step_digest(self, peer: int, dig: digest_mod.StreamDigest,
                          frames: int) -> None:
-        self.send(peer,
-                  _DIGEST_PAYLOAD.pack(dig.algo, dig.value(), frames),
+        self.send(peer, digest_mod.pack_check(dig, frames),
                   _check_frame=True)
 
     def verify_step_digest(self, peer: int, dig: digest_mod.StreamDigest,
@@ -850,11 +1079,11 @@ class ShmMesh:
                             f"{peer} to close the ring step but got a "
                             "data frame: step framing skew between "
                             "peers; aborting"))
-                    if hdr.size != _DIGEST_PAYLOAD.size:
+                    if hdr.size != digest_mod.CHECK_SIZE:
                         self._poison_stream(p, peer, HorovodInternalError(
                             f"digest-check frame from rank {peer} "
                             f"carries {hdr.size} bytes (expected "
-                            f"{_DIGEST_PAYLOAD.size}): misframed stream "
+                            f"{digest_mod.CHECK_SIZE}): misframed stream "
                             "(truncated or desynced); aborting"))
                     payload = self._recv_bounded(p, hdr.size)
                     p.frames_in += 1
@@ -865,7 +1094,7 @@ class ShmMesh:
                                 p, peer,
                                 FrameCorruptError(peer, p.frames_in,
                                                   hdr.crc, got))
-                    algo, value, count = _DIGEST_PAYLOAD.unpack(payload)
+                    algo, value, count = digest_mod.unpack_check(payload)
                     if algo != dig.algo:
                         self._poison_stream(p, peer, HorovodInternalError(
                             f"digest-check frame from rank {peer} uses "
@@ -985,32 +1214,12 @@ class ShmMesh:
     def _abort_write(self, p: _ShmPeer, bufs: List[memoryview]) -> None:
         """Ring write for the abort broadcast: ignores the mesh abort
         flag (it is ALREADY set — the normal path would refuse to write)
-        but keeps the short deadline and liveness probe."""
-        buf = p.shm.buf
-        cap = p.cap
-        deadline = time.monotonic() + 2.0
-        for b in bufs:
-            n = len(b)
-            off = 0
-            while off < n:
-                head = _load_u64(buf, p.out_head_off)
-                free = cap - (head - _load_u64(buf, p.out_tail_off))
-                if free == 0:
-                    if time.monotonic() > deadline:
-                        raise _ProgressStall(
-                            "shm ring full while broadcasting abort")
-                    self._require_peer_alive(p)
-                    # The nap Event is already set on this path, so only a
-                    # plain sleep actually yields; the 2 s deadline above
-                    # bounds it.
-                    time.sleep(_RING_NAP_SECS)  # hvdlint: disable=HVD001 -- bounded by the 2 s abort-broadcast deadline above
-                    continue
-                pos = head % cap
-                run = min(n - off, free, cap - pos)
-                p.out_ring[pos:pos + run] = b[off:off + run]
-                _store_u64(buf, p.out_head_off, head + run)
-                p.bump_bell(p.out_bell_off)
-                off += run
+        but keeps a short no-progress deadline and the liveness probe.
+        Rides the same :func:`sender_steps` protocol as every other send
+        — one bump per call, publish-before-sleep — where a previous
+        incarnation re-derived the ring run with a diverging per-RUN
+        bell bump."""
+        self._send_bounded(p, bufs, budget=2.0, ignore_abort=True)
 
     # -- concurrent helpers (ring-collective primitives) --------------------
 

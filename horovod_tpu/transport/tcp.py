@@ -42,7 +42,6 @@ notification path can interleave safely.
 
 from __future__ import annotations
 
-import collections
 import queue
 import select
 import socket
@@ -53,6 +52,20 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from . import digest as digest_mod
+# Frame-header contract — bits, structs, and size cap — lives in the
+# frame_bits registry (HVD008: defined once, imported everywhere).
+from .frame_bits import (
+    _CRC,
+    _CTRL_FLAG,
+    _DEFER_FLAG,
+    _DIGEST_FLAG,
+    _FLAGS_MASK,
+    _FrameHeader,
+    _LEN,
+    _MAX_FRAME_BYTES,
+    _WIRE_DTYPE_MASK,
+    _WIRE_DTYPE_SHIFT,
+)
 from ..common import faults
 from ..common.exceptions import (
     CoordinatedAbortError,
@@ -68,54 +81,10 @@ from .store import Store
 log = get_logger("horovod_tpu.transport.tcp")
 
 _HELLO = struct.pack("<I", 0x48564D54)  # "HVMT"
-_LEN = struct.Struct("<Q")
-# Wire CRC field (HOROVOD_WIRE_CRC, default on): crc32(payload) follows the
-# length word, so the full frame header is <Q len|flags><I crc32>.  Control
-# frames carry it too — one header layout, no per-frame-kind branches.  The
-# CRC is CORRUPTION detection, not authentication (docs/security.md); a
-# mismatch is unrecoverable by design because positional framing after a
-# bad frame cannot be trusted (see FrameCorruptError).
-_CRC = struct.Struct("<I")
-# Top bit of the 8-byte length header marks a CONTROL frame (coordinated
-# abort).  In-band marking keeps control delivery ordered with data on the
-# same socket while staying unambiguous against arbitrary payload bytes —
-# no payload is ever 2^63 bytes long.
-_CTRL_FLAG = 1 << 63
-# Digest-DEFERRED data frame: no inline <I> CRC field follows the length
-# word — the payload is covered by the ring step's chained shadow digest
-# instead (module docstring; docs/integrity.md).
-_DEFER_FLAG = 1 << 62
-# Digest-CHECK frame closing a deferred ring step (<B algo><Q digest>
-# <Q frames> payload, always inline-CRC'd when the mesh CRC is on).
-_DIGEST_FLAG = 1 << 61
-# Wire dtype code (3 bits) stamped by cast-on-the-wire compression:
-# 0 = raw/uncompressed; nonzero codes are allocated by
-# backend/compression.py.  Carried per frame so compression-config skew
-# between peers is a loud poisoned-stream abort, not silent garbage.
-_WIRE_DTYPE_SHIFT = 56
-_WIRE_DTYPE_MASK = 0x7 << _WIRE_DTYPE_SHIFT
-# All header flag bits — everything that is not payload length.
-_FLAGS_MASK = _CTRL_FLAG | _DEFER_FLAG | _DIGEST_FLAG | _WIRE_DTYPE_MASK
-# Digest-check frame payload: digest algorithm code, 64-bit chained
-# digest, frame count for the step it closes.
-_DIGEST_PAYLOAD = struct.Struct("<BQQ")
-
-#: Decoded frame header: ``crc`` is None when the mesh CRC is off or the
-#: frame is digest-deferred.
-_FrameHeader = collections.namedtuple(
-    "_FrameHeader", ("ctrl", "deferred", "check", "wire_dtype", "size", "crc"))
 # How often a blocked recv wakes to check the mesh-wide abort flag and its
 # progress deadline.  Bounds abort-propagation latency for threads blocked
 # on a DIFFERENT peer's socket than the one the abort arrived on.
 _ABORT_POLL_SECS = 0.25
-# Sanity cap on a frame's claimed payload size.  The length word itself is
-# not CRC-covered, and a flipped HIGH byte claims terabytes: recv would
-# allocate that buffer BEFORE any CRC or deadline could catch it
-# (MemoryError or the OOM killer, not a coordinated abort).  Real frames
-# are bounded by the fusion buffer (64 MB default) plus allgather fan-in —
-# orders of magnitude under this cap — so an oversized claim is treated
-# exactly like a CRC mismatch: poisoned stream, coordinated abort.
-_MAX_FRAME_BYTES = 1 << 32  # 4 GiB
 
 
 class _ProgressStall(Exception):
@@ -914,8 +883,7 @@ class TcpMesh:
         frame carrying (algo, chained digest, frame count), itself
         inline-CRC'd — the check frame IS the integrity settlement, so it
         never defers."""
-        self.send(peer,
-                  _DIGEST_PAYLOAD.pack(dig.algo, dig.value(), frames),
+        self.send(peer, digest_mod.pack_check(dig, frames),
                   _check_frame=True)
 
     def verify_step_digest(self, peer: int, dig: digest_mod.StreamDigest,
@@ -944,11 +912,11 @@ class TcpMesh:
                             f"{peer} to close the ring step but got a "
                             "data frame: step framing skew between "
                             "peers; aborting"))
-                    if hdr.size != _DIGEST_PAYLOAD.size:
+                    if hdr.size != digest_mod.CHECK_SIZE:
                         self._poison_stream(p, peer, HorovodInternalError(
                             f"digest-check frame from rank {peer} "
                             f"carries {hdr.size} bytes (expected "
-                            f"{_DIGEST_PAYLOAD.size}): misframed stream "
+                            f"{digest_mod.CHECK_SIZE}): misframed stream "
                             "(truncated or desynced); aborting"))
                     payload = self._recv_bounded(p, hdr.size)
                     p.frames_in += 1
@@ -959,7 +927,7 @@ class TcpMesh:
                                 p, peer,
                                 FrameCorruptError(peer, p.frames_in,
                                                   hdr.crc, got))
-                    algo, value, count = _DIGEST_PAYLOAD.unpack(payload)
+                    algo, value, count = digest_mod.unpack_check(payload)
                     if algo != dig.algo:
                         self._poison_stream(p, peer, HorovodInternalError(
                             f"digest-check frame from rank {peer} uses "

@@ -406,9 +406,13 @@ def test_hvd005_magic_not_first_write():
 
 
 def test_hvd005_ctrl_bit():
+    # The top-bit literal violates both the messages-layer contract
+    # (HVD005: don't touch the transport's control bit) and the registry
+    # split (HVD008: bit 56-63 literals live in frame_bits.py only).
     vs = run(HVD005_CTRL_BIT, path=MESSAGES_PATH)
-    assert codes(vs) == ["HVD005"]
-    assert "control-frame" in vs[0].message
+    assert codes(vs) == ["HVD005", "HVD008"]
+    assert "control-frame" in next(
+        v.message for v in vs if v.code == "HVD005")
 
 
 def test_hvd005_clean_and_scoped():
@@ -418,34 +422,51 @@ def test_hvd005_clean_and_scoped():
     assert run(HVD005_DUPLICATE) == []
 
 
-# -- extended header layout (integrity plane): transport/tcp.py contract --
+# -- extended header layout (integrity plane): frame_bits.py contract --
 
-TCP_PATH = os.path.join(PKG, "transport", "tcp.py")
+FRAME_BITS_PATH = os.path.join(PKG, "transport", "frame_bits.py")
 
-HVD005_TCP_CLEAN = """
+HVD005_BITS_CLEAN = """
     import struct
     _LEN = struct.Struct("<Q")
     _CRC = struct.Struct("<I")
     _CTRL_FLAG = 1 << 63
+    _DEFER_FLAG = 1 << 62
+    _DIGEST_FLAG = 1 << 61
 """
 
-HVD005_TCP_WRONG_LEN = """
+HVD005_BITS_WRONG_LEN = """
     import struct
     _LEN = struct.Struct("<I")
     _CRC = struct.Struct("<I")
     _CTRL_FLAG = 1 << 63
+    _DEFER_FLAG = 1 << 62
+    _DIGEST_FLAG = 1 << 61
 """
 
-HVD005_TCP_NO_CRC = """
+HVD005_BITS_NO_CRC = """
     import struct
     _LEN = struct.Struct("<Q")
     _CTRL_FLAG = 1 << 63
+    _DEFER_FLAG = 1 << 62
+    _DIGEST_FLAG = 1 << 61
 """
 
-HVD005_TCP_NO_CTRL = """
+HVD005_BITS_NO_CTRL = """
     import struct
     _LEN = struct.Struct("<Q")
     _CRC = struct.Struct("<I")
+    _DEFER_FLAG = 1 << 62
+    _DIGEST_FLAG = 1 << 61
+"""
+
+HVD005_BITS_WRONG_DEFER = """
+    import struct
+    _LEN = struct.Struct("<Q")
+    _CRC = struct.Struct("<I")
+    _CTRL_FLAG = 1 << 63
+    _DEFER_FLAG = 1 << 60
+    _DIGEST_FLAG = 1 << 61
 """
 
 HVD005_MESSAGES_CRC = """
@@ -461,27 +482,41 @@ HVD005_MESSAGES_CRC = """
 
 
 def test_hvd005_transport_header_clean():
-    assert run(HVD005_TCP_CLEAN, path=TCP_PATH) == []
-    # The 1 << 63 literal is RESERVED for tcp.py — owning it there is
-    # the contract, not a violation.
+    assert run(HVD005_BITS_CLEAN, path=FRAME_BITS_PATH) == []
+    # The bit-56..63 literals are RESERVED for frame_bits.py — owning
+    # them there is the contract, not a violation (HVD008 is scoped out).
 
 
 def test_hvd005_transport_wrong_len_format():
-    vs = run(HVD005_TCP_WRONG_LEN, path=TCP_PATH)
+    vs = run(HVD005_BITS_WRONG_LEN, path=FRAME_BITS_PATH)
     assert codes(vs) == ["HVD005"]
     assert "_LEN" in vs[0].message and "'<Q'" in vs[0].message
 
 
 def test_hvd005_transport_missing_crc_struct():
-    vs = run(HVD005_TCP_NO_CRC, path=TCP_PATH)
+    vs = run(HVD005_BITS_NO_CRC, path=FRAME_BITS_PATH)
     assert codes(vs) == ["HVD005"]
     assert "_CRC" in vs[0].message
 
 
 def test_hvd005_transport_missing_ctrl_flag():
-    vs = run(HVD005_TCP_NO_CTRL, path=TCP_PATH)
+    vs = run(HVD005_BITS_NO_CTRL, path=FRAME_BITS_PATH)
     assert codes(vs) == ["HVD005"]
     assert "_CTRL_FLAG" in vs[0].message
+
+
+def test_hvd005_transport_flag_on_wrong_bit():
+    # A flag declared on the WRONG bit is the same contract break as a
+    # missing one: the reservation names a position, not just a name.
+    vs = run(HVD005_BITS_WRONG_DEFER, path=FRAME_BITS_PATH)
+    assert codes(vs) == ["HVD005"]
+    assert "_DEFER_FLAG" in vs[0].message
+
+
+def test_hvd005_real_frame_bits_passes():
+    vs = lint_paths([os.path.join(PKG, "transport", "frame_bits.py")],
+                    PROJECT)
+    assert vs == [], vs
 
 
 def test_hvd005_messages_must_not_crc():
@@ -661,6 +696,159 @@ def test_hvd007_undocumented_metric_detected(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD008 — frame-header bit literals live only in transport/frame_bits.py
+# ---------------------------------------------------------------------------
+
+HVD008_VIOLATING = """
+    MY_CTRL = 1 << 63
+"""
+
+HVD008_DTYPE_LANE = """
+    def stamp(code):
+        return code << 56
+"""
+
+HVD008_REBIND = """
+    import struct
+    _CTRL_FLAG = 1 << 40
+"""
+
+HVD008_CLEAN = """
+    from horovod_tpu.transport.frame_bits import _CTRL_FLAG, _FLAGS_MASK
+    def is_ctrl(word):
+        return bool(word & _CTRL_FLAG)
+    LOW_BIT = 1 << 8          # below the flag lane: not wire framing
+    WIDE = (1 << 64) - 1      # a width mask, not a lane position
+"""
+
+HVD008_SUPPRESSED = """
+    MY_CTRL = 1 << 63  # hvdlint: disable=HVD008 -- fixture: testing the suppression path
+"""
+
+
+def test_hvd008_bit_literal():
+    vs = run(HVD008_VIOLATING)
+    assert codes(vs) == ["HVD008"]
+    assert "frame_bits" in vs[0].message
+
+
+def test_hvd008_dtype_lane_literal():
+    # Re-deriving the dtype lane shift (bit 56) is the same fork as the
+    # flag bits, even when the left operand is a variable.
+    vs = run(HVD008_DTYPE_LANE)
+    assert codes(vs) == ["HVD008"]
+
+
+def test_hvd008_registry_name_rebind():
+    # Shadowing a registry name forks the contract even with an
+    # off-lane value.
+    vs = run(HVD008_REBIND)
+    assert codes(vs) == ["HVD008"]
+    assert "_CTRL_FLAG" in vs[0].message
+
+
+def test_hvd008_clean():
+    assert run(HVD008_CLEAN) == []
+
+
+def test_hvd008_suppressed():
+    assert run(HVD008_SUPPRESSED) == []
+
+
+def test_hvd008_scoped_out_of_frame_bits():
+    # The registry itself is the one place the literals belong (the
+    # fixture still trips HVD005's header-contract check there, which is
+    # that rule's business, not this one's).
+    vs = run(HVD008_VIOLATING, path=FRAME_BITS_PATH)
+    assert [v for v in vs if v.code == "HVD008"] == []
+
+
+# ---------------------------------------------------------------------------
+# HVD009 — shm control words move only through the accessor helpers
+# ---------------------------------------------------------------------------
+
+SHM_PATH = os.path.join(PKG, "transport", "shm.py")
+
+HVD009_VIOLATING = """
+    import struct
+    _U64 = struct.Struct("<Q")
+    _OFF_L2H_HEAD = 256
+    def peek_head(buf):
+        return _U64.unpack_from(buf, _OFF_L2H_HEAD)[0]
+"""
+
+HVD009_ATTR_VIOLATING = """
+    import struct
+    _U32 = struct.Struct("<I")
+    def peek_bell(buf, p):
+        return _U32.unpack_from(buf, p.in_data_bell_off)[0]
+"""
+
+HVD009_CLEAN = """
+    import struct
+    _HDR = struct.Struct("<II")
+    def walk(blob, off):
+        return _HDR.unpack_from(blob, off)
+"""
+
+HVD009_SUPPRESSED = """
+    import struct
+    _U64 = struct.Struct("<Q")
+    _OFF_L2H_HEAD = 256
+    def peek_head(buf):
+        return _U64.unpack_from(buf, _OFF_L2H_HEAD)[0]  # hvdlint: disable=HVD009 -- fixture: testing the suppression path
+"""
+
+HVD009_SHM_ACCESSOR_CLEAN = """
+    import struct
+    _U64 = struct.Struct("<Q")
+    def _load_u64(buf, off):
+        return _U64.unpack_from(buf, off)[0]
+    def _store_u64(buf, off, value):
+        _U64.pack_into(buf, off, value)
+"""
+
+HVD009_SHM_BARE_STRUCT = """
+    import struct
+    _HDR = struct.Struct("<II")
+    def sidestep(buf, off):
+        return _HDR.unpack_from(buf, off)
+"""
+
+
+def test_hvd009_offset_constant():
+    vs = run(HVD009_VIOLATING)
+    assert codes(vs) == ["HVD009"]
+    assert "_OFF_L2H_HEAD" in vs[0].message
+
+
+def test_hvd009_offset_attribute():
+    vs = run(HVD009_ATTR_VIOLATING)
+    assert codes(vs) == ["HVD009"]
+    assert "in_data_bell_off" in vs[0].message
+
+
+def test_hvd009_clean_bare_offset_elsewhere():
+    # journal.py-style framed walks over a blob use plain offsets; only
+    # the shm header-offset vocabulary marks a control word.
+    assert run(HVD009_CLEAN) == []
+
+
+def test_hvd009_suppressed():
+    assert run(HVD009_SUPPRESSED) == []
+
+
+def test_hvd009_shm_accessors_are_the_allowlist():
+    # Inside transport/shm.py the four accessors may move raw structs...
+    assert run(HVD009_SHM_ACCESSOR_CLEAN, path=SHM_PATH) == []
+    # ...and ANY other struct move in that file is a hole in the
+    # model-checked access set, offset vocabulary or not.
+    vs = run(HVD009_SHM_BARE_STRUCT, path=SHM_PATH)
+    assert codes(vs) == ["HVD009"]
+    assert "accessors" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
 # HVD000 — suppression hygiene
 # ---------------------------------------------------------------------------
 
@@ -721,6 +909,8 @@ def test_no_anonymous_threads_in_tree(tree_violations):
     ("HVD004", HVD004_VIOLATING),
     ("HVD006", HVD006_VIOLATING),
     ("HVD007", HVD007_VIOLATING),
+    ("HVD008", HVD008_VIOLATING),
+    ("HVD009", HVD009_VIOLATING),
 ])
 def test_seeded_violation_fails_with_right_code(tmp_path, code, fixture):
     """Seeding any single violation into a linted tree must fail the pass
@@ -745,4 +935,5 @@ def test_cli_exit_codes(tmp_path, capsys):
 
 def test_rule_codes_catalog():
     assert RULE_CODES == {"HVD000", "HVD001", "HVD002", "HVD003",
-                          "HVD004", "HVD005", "HVD006", "HVD007"}
+                          "HVD004", "HVD005", "HVD006", "HVD007",
+                          "HVD008", "HVD009"}

@@ -1,0 +1,166 @@
+"""hvd-mck: the checker's acceptance contract, pinned as tests.
+
+Four claims, each of which is load-bearing for trusting the shm ring:
+
+- **tso proves**: the exhaustive bounded run over every scenario is
+  complete (not truncated) and violation-free — the deployment claim.
+- **weak refutes**: allowing store-store reordering must FIND the
+  missed wakeup, with a concrete minimal schedule.  A checker that
+  cannot rediscover the bug the protocol was designed against proves
+  nothing by passing.
+- **mutants die**: every seeded protocol bug (mutations.py) is killed
+  within the configured bounds, each by one of its expected violation
+  classes, each with a reproducing schedule.
+- **truncation is honest**: hitting the schedule cap is reported as
+  incomplete and fails the CI smoke gate — never silently passes as
+  exhaustive.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from horovod_tpu.tools.mck import main  # noqa: E402
+from horovod_tpu.tools.mck.explore import check, explore  # noqa: E402
+from horovod_tpu.tools.mck.model import (  # noqa: E402
+    V_MISSED_WAKEUP,
+)
+from horovod_tpu.tools.mck.mutations import MUTATIONS  # noqa: E402
+from horovod_tpu.tools.mck.scenarios import SCENARIOS  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# tso: the deployment claim
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_tso_exhaustive_and_clean(name):
+    res = check(SCENARIOS[name], "tso")
+    assert res.complete, (
+        f"tso run over {name!r} truncated at {res.schedules} schedules — "
+        "an incomplete exploration is not a proof")
+    assert res.ok, (
+        f"tso violations in {name!r}: "
+        + "; ".join(f"{v.name}: {v.detail}" for v in res.violations.values()))
+    assert res.schedules > 1  # it actually explored interleavings
+
+
+def test_tso_is_deterministic():
+    # Replay-based DFS over generators must be exactly reproducible:
+    # same scenario, same bound, same schedule count, step for step.
+    a = explore(SCENARIOS["wrap"], "tso")
+    b = explore(SCENARIOS["wrap"], "tso")
+    assert (a.schedules, a.max_depth) == (b.schedules, b.max_depth)
+
+
+# ---------------------------------------------------------------------------
+# weak: the counterfactual must fail
+# ---------------------------------------------------------------------------
+
+def test_weak_finds_missed_wakeup():
+    res = check(SCENARIOS["basic"], "weak")
+    assert V_MISSED_WAKEUP in res.violations, (
+        "weak mode failed to find the missed wakeup store-store "
+        f"reordering causes (found: {sorted(res.violations)})")
+    viol = res.violations[V_MISSED_WAKEUP]
+    # The counterexample is a concrete, non-empty schedule a human can
+    # replay, found at a minimal preemption bound.
+    assert viol.schedule, "counterexample carries no schedule"
+    assert res.min_bound is not None and res.min_bound <= res.bound
+
+
+def test_weak_counterexample_tells_the_reordering_story():
+    # The schedule is the human-facing artifact: it must show the
+    # out-of-order store-buffer flush AND the victim going to sleep on
+    # the bell — the two halves of the missed wakeup.
+    res = check(SCENARIOS["basic"], "weak")
+    trace = "\n".join(res.violations[V_MISSED_WAKEUP].schedule)
+    assert "flush(" in trace, (
+        "a weak-ordering counterexample must involve a store-buffer "
+        f"flush:\n{trace}")
+    assert "FUTEX_WAIT" in trace and "sleep" in trace, (
+        f"no sleeper on the counterexample path:\n{trace}")
+
+
+# ---------------------------------------------------------------------------
+# the mutation-kill suite: the checker's checker
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(MUTATIONS))
+def test_mutation_killed(name):
+    mut = MUTATIONS[name]
+    res = check(SCENARIOS[mut.scenario], "tso", mutation=mut)
+    caught = set(res.violations) & mut.expected
+    assert caught, (
+        f"mutant {name!r} SURVIVED the exhaustive run (expected one of "
+        f"{sorted(mut.expected)}, found {sorted(res.violations)}): the "
+        "configured bounds no longer catch seeded protocol bugs")
+    for cls in caught:
+        assert res.violations[cls].schedule, (
+            f"kill of {name!r} by {cls} carries no reproducing schedule")
+
+
+def test_mutation_suite_is_nontrivial():
+    # At least the ISSUE's four classic ring bugs, each on a side and
+    # scenario where it can actually bite.
+    assert len(MUTATIONS) >= 4
+    assert {"swap_publish_bump", "drop_bell_precheck",
+            "free_space_off_by_one", "skip_final_wake"} <= set(MUTATIONS)
+
+
+# ---------------------------------------------------------------------------
+# truncation honesty + CLI contract
+# ---------------------------------------------------------------------------
+
+def test_truncated_run_is_not_a_proof():
+    res = explore(SCENARIOS["basic"], "tso", max_schedules=3)
+    assert res.truncated and not res.complete
+    assert res.schedules <= 3
+
+
+def test_cli_tso_smoke_passes(capsys):
+    assert main(["--mode", "tso", "--smoke", "-q"]) == 0
+    out = capsys.readouterr().out
+    assert "no violations" in out or "ok" in out.lower()
+
+
+def test_cli_weak_fails_with_counterexample(capsys):
+    assert main(["--mode", "weak", "--scenario", "basic", "-q"]) == 1
+    out = capsys.readouterr().out
+    assert V_MISSED_WAKEUP in out
+
+
+def test_cli_mutants_all_killed(capsys):
+    assert main(["--mutants", "-q"]) == 0
+    out = capsys.readouterr().out
+    assert "mutants killed" in out
+
+
+def test_cli_smoke_trips_on_truncation(capsys):
+    assert main(["--mode", "tso", "--scenario", "basic", "--smoke",
+                 "--max-schedules", "3", "-q"]) == 2
+
+
+def test_cli_unknown_names(capsys):
+    assert main(["--scenario", "nope"]) == 2
+    assert main(["--mutation", "nope"]) == 2
+
+
+def test_cli_json_report(tmp_path, capsys):
+    path = tmp_path / "mck.json"
+    assert main(["--mode", "tso", "--scenario", "basic", "-q",
+                 "--json", str(path)]) == 0
+    doc = json.loads(path.read_text())
+    assert doc["tool"] == "hvd-mck"
+    assert doc["mode"] == "tso"
+    assert doc["ok"] and doc["complete"]
+    run = doc["runs"][0]
+    assert run["scenario"] == "basic"
+    assert run["complete"] and run["violations"] == []
