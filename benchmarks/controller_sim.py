@@ -27,9 +27,15 @@ HTTPStoreClient with the op mix one membership-churn event costs the
 elastic driver at world size N — full lease scan (keys + N gets), slot
 table republish (N puts), and a full round of lease renewals (N puts) —
 plus what durability adds: journal bytes on disk, compaction
-generations, and cold-restart replay time.  Baseline artifact:
+generations, and cold-restart replay time.  Each record also carries an
+``attribution`` block (unless ``--no-trace``): the server+driver traces
+are merged and run through ``hvd-control-path`` so the JSON says how the
+per-event wall time splits across store lock wait / journal fsync / HTTP
+round-trips.  Baseline artifact:
 ``python benchmarks/controller_sim.py --churn --world-sizes 64
---out benchmarks/results/controller_churn_np64.json``.
+--out benchmarks/results/controller_churn_np64.json``; the metrics
+overhead guard (gate: <= 1.05x) is ``--churn --overhead-out
+benchmarks/results/server_metrics_overhead_r14.json``.
 """
 
 from __future__ import annotations
@@ -164,10 +170,17 @@ def _percentile(sorted_ms, frac):
                                len(sorted_ms) - 1)], 3)
 
 
-def run_churn_case(world: int, events: int) -> dict:
+def run_churn_case(world: int, events: int, trace: bool = True) -> dict:
     """One membership-churn baseline at world size N, end to end through
     the journaled rendezvous server (started in-process, driven over
-    HTTP like a real driver would)."""
+    HTTP like a real driver would).
+
+    With ``trace=True`` (the default) the server writes its control-plane
+    timeline (RV_* handler spans, RV_LOCK_WAIT, JR_* journal spans), the
+    sim drives the client under a driver-pid timeline (RVC_* round-trips
+    plus one CHURN_EVENT window per event), and the merged traces are fed
+    through ``hvd-control-path`` in-process — the record then carries an
+    ``attribution`` block saying where each event's wall time went."""
     import shutil
     import tempfile
 
@@ -175,9 +188,21 @@ def run_churn_case(world: int, events: int) -> dict:
     from horovod_tpu.transport.store import LEASE_SCOPE, HTTPStoreClient
 
     jdir = tempfile.mkdtemp(prefix="hvd-churn-")
-    server = RendezvousServer("127.0.0.1", journal_dir=jdir)
+    tdir = tempfile.mkdtemp(prefix="hvd-churn-trace-") if trace else None
+    server_trace = os.path.join(tdir, "server.json") if trace else None
+    server = RendezvousServer("127.0.0.1", journal_dir=jdir,
+                              trace_path=server_trace)
     port = server.start()
     client = HTTPStoreClient("127.0.0.1", port)
+    tl = None
+    if trace:
+        from horovod_tpu.core.timeline import DRIVER_TRACE_PID, Timeline
+
+        # Plays the driver's role: activates so the client's RVC_* spans
+        # have a sink; offset 0 — same host as the server (clock base).
+        tl = Timeline(os.path.join(tdir, "driver.json"),
+                      rank=DRIVER_TRACE_PID, clock_offset_ns=0,
+                      process_name="churn driver (sim)")
     identities = [f"host{r:03d}:0" for r in range(world)]
 
     def publish_table(epoch: int) -> None:
@@ -209,6 +234,7 @@ def run_churn_case(world: int, events: int) -> dict:
         # One churn event = what one epoch advance costs the driver:
         # scan every lease, republish the whole table, absorb a renewal
         # round at the new epoch.  Deterministic — no randomness.
+        t0_ns = time.monotonic_ns() if tl is not None else 0
         t0 = time.perf_counter()
         lease_scan()
         t1 = time.perf_counter()
@@ -219,7 +245,28 @@ def run_churn_case(world: int, events: int) -> dict:
         scan_ms.append((t1 - t0) * 1e3)
         republish_ms.append((t2 - t1) * 1e3)
         event_ms.append((t3 - t0) * 1e3)
+        if tl is not None:
+            tl.span_since("driver", "CHURN_EVENT", t0_ns,
+                          {"cause": "sim", "epoch": event + 1})
+    if tl is not None:
+        tl.close()
     server.stop()
+
+    attribution = None
+    if trace:
+        from horovod_tpu.tools.control_path import analyze
+        from horovod_tpu.tools.trace_merge import load_trace, merge
+
+        doc = analyze(merge([load_trace(server_trace),
+                             load_trace(os.path.join(tdir, "driver.json"))]))
+        attribution = {
+            "coverage": doc["coverage"],
+            "phase_share": doc["phase_share"],
+            "phase_ms_per_event": {
+                p: round(v / 1e3 / max(events, 1), 3)
+                for p, v in doc["phase_totals_us"].items()},
+        }
+        shutil.rmtree(tdir, ignore_errors=True)
 
     journal_bytes = sum(
         os.path.getsize(os.path.join(jdir, f)) for f in os.listdir(jdir))
@@ -238,7 +285,7 @@ def run_churn_case(world: int, events: int) -> dict:
     shutil.rmtree(jdir, ignore_errors=True)
 
     event_ms.sort(), scan_ms.sort(), republish_ms.sort()
-    return {
+    rec = {
         "metric": "controller_churn",
         "world_size": world,
         "events": events,
@@ -253,6 +300,39 @@ def run_churn_case(world: int, events: int) -> dict:
         "replay_ms": round(replay_ms, 3),
         "replayed_keys": replayed_keys,
     }
+    if attribution is not None:
+        rec["attribution"] = attribution
+    return rec
+
+
+def run_churn_overhead(world: int, events: int, rounds: int) -> dict:
+    """Interleaved A/B guard for the server-side metrics instrumentation:
+    alternate metrics-on / metrics-off churn rounds (tracing off in BOTH
+    arms — this isolates the always-on metrics cost, not the opt-in
+    timeline cost) and compare medians.  Interleaving makes the arms see
+    the same thermal/cache drift; the PR gate is ratio <= 1.05."""
+    from horovod_tpu.core import metrics
+
+    samples = {"on": [], "off": []}
+    try:
+        for _ in range(rounds):
+            for mode in ("on", "off"):
+                metrics.configure(mode == "on")
+                rec = run_churn_case(world, events, trace=False)
+                samples[mode].append(rec["event_ms_p50"])
+    finally:
+        metrics.configure(None)  # back to env-driven policy
+    med = {m: sorted(v)[len(v) // 2] for m, v in samples.items()}
+    return {
+        "metric": "server_metrics_overhead",
+        "world_size": world,
+        "events": events,
+        "rounds": rounds,
+        "event_ms_p50_on": med["on"],
+        "event_ms_p50_off": med["off"],
+        "ratio": round(med["on"] / med["off"], 4),
+        "samples_ms": samples,
+    }
 
 
 def main() -> int:
@@ -266,13 +346,30 @@ def main() -> int:
                         "rendezvous server instead of the coordinator sim")
     p.add_argument("--events", type=int, default=20,
                    help="churn events per world size (--churn only)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="skip trace capture + attribution (--churn only)")
+    p.add_argument("--overhead-out", default=None, metavar="PATH",
+                   help="instead of the churn sweep, run the interleaved "
+                        "metrics on/off A/B at the first world size and "
+                        "write the overhead record here (--churn only)")
+    p.add_argument("--overhead-rounds", type=int, default=5)
     p.add_argument("--out", default=None)
     args = p.parse_args()
+
+    if args.churn and args.overhead_out:
+        rec = run_churn_overhead(args.world_sizes[0], args.events,
+                                 args.overhead_rounds)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(args.overhead_out, "w") as f:
+            f.write(line + "\n")
+        return 0
 
     lines = []
     for world in args.world_sizes:
         if args.churn:
-            rec = run_churn_case(world, args.events)
+            rec = run_churn_case(world, args.events,
+                                 trace=not args.no_trace)
         else:
             rec = run_case(world, args.tensors, args.cycles)
         line = json.dumps(rec)

@@ -65,7 +65,9 @@ EOF
     python -m horovod_tpu.tools.prom_validate ci/metrics_smoke.last.scrape \
         --required controller_cycles_total controller_cycle_seconds \
         collective_latency_seconds tensor_queue_depth phase_seconds_total \
-        wire_bytes_on_wire_total rendezvous_store_ops_total
+        wire_bytes_on_wire_total rendezvous_store_ops_total \
+        rendezvous_request_seconds rendezvous_requests_in_flight \
+        rendezvous_scope_ops_total rendezvous_store_lock_wait_seconds
 } > ci/metrics_smoke.last.log 2>&1 || rc=$?
 cat ci/metrics_smoke.last.log
 [ "$rc" -eq 0 ] || { echo "metrics smoke FAILED (rc=$rc)"; exit "$rc"; }

@@ -196,6 +196,15 @@ HOROVOD_STRAGGLER_EWMA_ALPHA = "HOROVOD_STRAGGLER_EWMA_ALPHA"
 # every rank.  Only consulted when a timeline is active; costs one
 # module-attribute read otherwise.
 HOROVOD_TIMELINE_LIFECYCLE = "HOROVOD_TIMELINE_LIFECYCLE"
+# Path of the rendezvous server's own timeline trace file.  The server is
+# the clock base every worker syncs against (tools/trace_merge.py), so its
+# spans merge with worker traces unshifted.  Empty/unset: no server trace.
+HOROVOD_SERVER_TIMELINE = "HOROVOD_SERVER_TIMELINE"
+# Control-plane spans ("1"/"0", default on): rendezvous request spans on
+# the server trace, store-client round-trip spans and driver churn spans
+# on whichever timeline is active.  Only consulted when a timeline
+# exists; costs one module-attribute read otherwise.
+HOROVOD_TIMELINE_CONTROL_PLANE = "HOROVOD_TIMELINE_CONTROL_PLANE"
 
 # -- core runtime tunables (reference common.h:64-91) --
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"  # bytes, default 64MB

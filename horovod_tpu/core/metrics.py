@@ -118,6 +118,53 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "lease_expirations_total": (
         "counter", "worker leases the elastic driver declared expired "
                    "(dead worker => epoch advance; driver only)"),
+    # -- control plane: rendezvous server / journal / driver
+    #    (docs/observability.md "Control-plane attribution") --
+    "rendezvous_request_seconds": (
+        "histogram", "server-side HTTP request handling latency, labeled "
+                     "op=put|get|delete|keys|metrics|clock (rendezvous "
+                     "server process only)"),
+    "rendezvous_requests_in_flight": (
+        "gauge", "HTTP requests the rendezvous server is handling right "
+                 "now (threaded server; >1 means concurrent clients)"),
+    "rendezvous_scope_ops_total": (
+        "counter", "server-side KV operations per namespace, labeled "
+                   "scope=/op= (which plane — lease, metrics, discovery, "
+                   "rendezvous table — generates the request load)"),
+    "rendezvous_store_lock_wait_seconds": (
+        "histogram", "time a server handler thread waited to acquire the "
+                     "store lock (contention term of request latency)"),
+    "journal_append_seconds": (
+        "histogram", "durable-store journal append, frame write through "
+                     "fsync (the per-mutation durability tax)"),
+    "journal_fsync_seconds": (
+        "histogram", "fsync portion of a journal append/compaction "
+                     "(0-sample when HOROVOD_JOURNAL_FSYNC=0)"),
+    "journal_replay_seconds": (
+        "histogram", "journal recovery replay duration at store open"),
+    "journal_truncated_tails_total": (
+        "counter", "torn journal tails discarded during recovery (each is "
+                   "one crash mid-append survived)"),
+    "journal_compaction_seconds": (
+        "histogram", "snapshot compaction duration (journal rewrite)"),
+    "journal_generation": (
+        "gauge", "current journal snapshot generation (bumps once per "
+                 "compaction; pairs with journal_compaction_seconds)"),
+    "leases_live": (
+        "gauge", "worker liveness leases the elastic driver currently "
+                 "tracks as live (driver only; updated each lease scan)"),
+    "lease_min_ttl_seconds": (
+        "gauge", "smallest time-to-expiry across live leases (driver "
+                 "only; negative means a lease is inside its grace "
+                 "window and about to be declared expired)"),
+    "driver_tick_seconds": (
+        "histogram", "elastic driver discovery-tick duration (lease scan "
+                     "+ host discovery + any epoch transition it caused)"),
+    "driver_epoch_transitions_total": (
+        "counter", "elastic driver epoch advances, labeled cause="
+                   "lease_expiry|reset_request|worker_exit|host_change "
+                   "(driver only; the flight recorder carries the same "
+                   "cause tag per event)"),
     # -- integrity / failure plane --
     "crc_verify_seconds_total": (
         "counter", "seconds spent computing/verifying wire CRC32 "

@@ -47,6 +47,20 @@ CLOCK_SYNC_EVENT = "clock_sync"
 #: is active.
 LIFECYCLE_ENABLED = env_mod.get_bool(env_mod.HOROVOD_TIMELINE_LIFECYCLE, True)
 
+#: Control-plane spans (``RV_*`` on the server trace, ``RVC_*`` client
+#: round-trips, ``DRV_*``/``CHURN_EVENT`` on the driver trace), consumed
+#: by ``tools/control_path.py``.  Same gating discipline as
+#: ``LIFECYCLE_ENABLED``.
+CONTROL_PLANE_ENABLED = env_mod.get_bool(
+    env_mod.HOROVOD_TIMELINE_CONTROL_PLANE, True)
+
+#: Reserved trace pids for the control-plane processes.  Workers own the
+#: non-negative pids (pid = rank); the rendezvous server and the elastic
+#: driver get sentinel lanes so a merged trace keeps them distinct from
+#: every possible rank.
+SERVER_TRACE_PID = -1
+DRIVER_TRACE_PID = -2
+
 #: The process's live Timeline, set by the constructor and cleared by
 #: ``close()``: instrumentation sites that can't reach the global state
 #: object (tensor queue, ring backend) emit lifecycle records through the
@@ -73,6 +87,29 @@ def lifecycle_instant(tensor_name: str, stage: str,
     tl = ACTIVE
     if tl is not None and LIFECYCLE_ENABLED:
         tl.lifecycle_mark(tensor_name, stage, cycle=cycle)
+
+
+def control_active() -> bool:
+    """True when a control-plane span emitted now would land somewhere.
+    Instrumentation sites sample ``time.monotonic_ns()`` only when this
+    holds, so the off path stays at two module-attribute reads."""
+    return ACTIVE is not None and CONTROL_PLANE_ENABLED
+
+
+def control_span_since(lane: str, name: str, t0_mono_ns: int,
+                       **args) -> None:
+    """Retroactive control-plane span on the active timeline: covers
+    ``[t0_mono_ns, now]`` (caller sampled ``time.monotonic_ns()`` before
+    the work).  No-op when no timeline is active or the knob is off."""
+    tl = ACTIVE
+    if tl is not None and CONTROL_PLANE_ENABLED:
+        tl.span_since(lane, name, t0_mono_ns, args or None)
+
+
+def control_instant(lane: str, name: str, **args) -> None:
+    tl = ACTIVE
+    if tl is not None and CONTROL_PLANE_ENABLED:
+        tl.instant(lane, name, args or None)
 
 
 def rank_trace_path(path: str, rank: int) -> str:
@@ -113,7 +150,9 @@ def estimate_server_clock_offset_ns(samples: int = 3) -> Optional[int]:
 
 class Timeline:
     def __init__(self, path: str, mark_cycles: bool = False, rank: int = 0,
-                 clock_offset_ns: Optional[int] = None):
+                 clock_offset_ns: Optional[int] = None,
+                 activate: bool = True,
+                 process_name: Optional[str] = None):
         self._path = path
         self._mark_cycles = mark_cycles
         self._pid = rank
@@ -133,13 +172,18 @@ class Timeline:
             target=self._writer_loop, name="horovod-timeline", daemon=True)
         self._writer.start()
         self._emit({"name": "process_name", "ph": "M", "pid": self._pid,
-                    "args": {"name": f"horovod_tpu rank {rank}"}})
+                    "args": {"name": process_name
+                             or f"horovod_tpu rank {rank}"}})
         self._emit({"name": CLOCK_SYNC_EVENT, "ph": "M", "pid": self._pid,
                     "args": {"wall_base_ns": self._wall_base_ns,
                              "server_offset_ns": clock_offset_ns,
                              "rank": rank}})
-        global ACTIVE
-        ACTIVE = self
+        # Secondary timelines (the rendezvous server's trace lives inside
+        # the launcher process next to the workers') opt out of owning the
+        # module-level ACTIVE slot.
+        if activate:
+            global ACTIVE
+            ACTIVE = self
 
     # -- producers (background/controller thread; never block) -------------
 
@@ -231,6 +275,30 @@ class Timeline:
                     "tid": self._tid(tensor_name), "ts": self._ts_us(),
                     "args": {"cycle": self._cycle if cycle is None
                              else cycle}})
+
+    def span_since(self, lane: str, name: str, t0_mono_ns: int,
+                   args: Optional[dict] = None) -> None:
+        """Complete ("X") control-plane span on a named lane, covering
+        ``[t0_mono_ns, now]``.  Complete events are atomic — concurrent
+        handler threads can land overlapping spans on one lane without
+        the B/E mis-nesting a shared stack would suffer."""
+        b_us = (t0_mono_ns - self._start) / 1e3
+        rec = {"name": name, "ph": "X", "pid": self._pid,
+               "tid": self._tid(lane), "ts": b_us,
+               "dur": self._ts_us() - b_us}
+        if args:
+            rec["args"] = dict(args)
+        self._emit(rec)
+
+    def instant(self, lane: str, name: str,
+                args: Optional[dict] = None) -> None:
+        """Instant marker on a named lane (control-plane events like
+        ``EPOCH_TRANSITION``)."""
+        rec = {"name": name, "ph": "i", "s": "t", "pid": self._pid,
+               "tid": self._tid(lane), "ts": self._ts_us()}
+        if args:
+            rec["args"] = dict(args)
+        self._emit(rec)
 
     def mark_cycle(self) -> None:
         if self._mark_cycles:

@@ -37,9 +37,11 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..common import env as env_mod
 from ..common import faults
 from ..common.logging_util import get_logger
+from ..core import flight_recorder
 from ..core import metrics
+from ..core import timeline as timeline_mod
 from ..runner.hosts import SlotInfo, get_host_assignments
-from ..runner.rendezvous import RendezvousServer
+from ..runner.rendezvous import ExternalRendezvous, RendezvousServer
 from ..transport.store import LEASE_SCOPE
 from .constants import (
     DEFAULT_CRASH_FAILURE_LIMIT,
@@ -216,7 +218,13 @@ class ElasticDriver:
                 if identity not in self._known_identities:
                     log.info("spawning worker %s (epoch %d, rank %d)",
                              identity, self.epoch, s.rank)
+                    t_spawn = time.monotonic_ns() \
+                        if timeline_mod.control_active() else None
                     self._create_worker(s, self.epoch)
+                    if t_spawn is not None:
+                        timeline_mod.control_span_since(
+                            "driver", "DRV_SPAWN", t_spawn,
+                            identity=identity, epoch=self.epoch)
                     self._exited_identities.discard(identity)
                     self.rendezvous.set("epoch_ack", identity,
                                         str(self.epoch).encode())
@@ -251,82 +259,134 @@ class ElasticDriver:
 
     def _discovery_loop(self) -> None:
         while not self._shutdown.is_set():
+            t_wait = time.monotonic_ns() \
+                if timeline_mod.control_active() else None
             self._wakeup.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+            if t_wait is not None:
+                timeline_mod.control_span_since("driver", "DRV_WAIT", t_wait)
             self._wakeup.clear()
             if self._shutdown.is_set():
                 return
             # Chaos site for driver-death scenarios: action=raise kills
             # this thread (a wedged driver), exit kills the launcher.
-            # Deliberately OUTSIDE the outage try — an injected raise
-            # must not read as "store unreachable".
+            # Deliberately OUTSIDE the tick timing — an injected raise
+            # must not land a latency sample.
             if faults.ACTIVE:
                 faults.inject("driver.tick")
-            # Every per-tick store op rides one try: a failure means the
-            # store is down/partitioned, NOT that workers died — freeze
-            # membership judgment (no lease expiry, no epoch advance)
-            # until it answers again, then re-grace the lease clocks.
+            t0 = time.monotonic_ns()
             try:
-                self._renotify_unacked()
-                reset_reasons = self._pending_reset_requests()
-                expired = self._scan_leases()
-                self._store_recovered()
-            except self._STORE_ERRORS as e:
-                self._store_outage(e)
-                continue
-            try:
-                changed, removal = self.hosts.update_available_hosts()
-            except Exception as e:  # noqa: BLE001 — discovery script hiccups
-                log.warning("host discovery failed: %s", e)
-                continue
-            # Identities that should have a process but whose worker died
-            # (without the host being blacklisted) need a respawn epoch.
-            with self._lock:
-                if self._success:
-                    # Winding down: never rendezvous a new epoch once a
-                    # worker finished — a fresh slot table would assign a
-                    # rank to the dead-but-successful identity and hang the
-                    # survivors' mesh build.
-                    continue
-                if expired:
-                    # A lease expired with the store REACHABLE: the worker
-                    # is genuinely dead (or wedged past saving) — drop it
-                    # from the known set so the missing-workers path below
-                    # advances the epoch THIS tick.
-                    metrics.inc("lease_expirations_total", len(expired))
-                    for identity in sorted(expired):
-                        log.warning(
-                            "worker %s lease expired (no renewal in %.0fs "
-                            "with the store reachable); declaring dead",
-                            identity, self.lease_timeout)
-                        self._known_identities.pop(identity, None)
-                        self._lease_seen.pop(identity, None)
-                missing_workers = {
-                    f"{s.hostname}:{s.local_rank}" for s in self._slots
-                } - set(self._known_identities)
-            if not changed and not missing_workers and not reset_reasons:
-                continue
-            if self.reset_limit is not None and \
-                    self.resets >= self.reset_limit:
-                msg = (f"elastic reset limit {self.reset_limit} reached; "
-                       "stopping job (reference RESET_LIMIT_EXCEEDED)")
-                log.error(msg)
-                self.stop(error_message=msg)
+                self._tick(t0)
+            finally:
+                if metrics.ENABLED:
+                    metrics.observe("driver_tick_seconds",
+                                    (time.monotonic_ns() - t0) / 1e9)
+
+    def _tick(self, t0_ns: int) -> None:
+        """One discovery tick (the former loop body; early returns are the
+        old ``continue``s).  ``t0_ns`` anchors the CHURN_EVENT span when
+        this tick advances the epoch, so the span covers the detection
+        work (lease scan, reset-request reads) that led to it."""
+        # Every per-tick store op rides one try: a failure means the
+        # store is down/partitioned, NOT that workers died — freeze
+        # membership judgment (no lease expiry, no epoch advance)
+        # until it answers again, then re-grace the lease clocks.
+        try:
+            self._renotify_unacked()
+            reset_reasons = self._pending_reset_requests()
+            expired = self._scan_leases()
+            self._store_recovered()
+            self._push_driver_metrics()
+        except self._STORE_ERRORS as e:
+            self._store_outage(e)
+            return
+        try:
+            changed, removal = self.hosts.update_available_hosts()
+        except Exception as e:  # noqa: BLE001 — discovery script hiccups
+            log.warning("host discovery failed: %s", e)
+            return
+        # Identities that should have a process but whose worker died
+        # (without the host being blacklisted) need a respawn epoch.
+        with self._lock:
+            if self._success:
+                # Winding down: never rendezvous a new epoch once a
+                # worker finished — a fresh slot table would assign a
+                # rank to the dead-but-successful identity and hang the
+                # survivors' mesh build.
                 return
-            if self.hosts.total_slots() < self.min_np:
-                log.warning("host change leaves fewer than min_np slots; "
-                            "waiting for capacity")
-                continue
-            # A worker-initiated reset (e.g. corruption abort with every
-            # process still alive) is removal-LIKE for sync purposes: the
-            # workers rolled back and must state.sync() after the reset.
-            removalish = removal or bool(missing_workers) \
-                or bool(reset_reasons)
-            log.info("host set changed (removal=%s, dead_workers=%s, "
-                     "reset_requests=%s); advancing epoch",
-                     removal, sorted(missing_workers), reset_reasons)
-            self._rendezvous_epoch()
-            self._await_ack = not removalish  # remember flavor for re-notify
-            self._notify_workers(added_only=not removalish)
+            if expired:
+                # A lease expired with the store REACHABLE: the worker
+                # is genuinely dead (or wedged past saving) — drop it
+                # from the known set so the missing-workers path below
+                # advances the epoch THIS tick.
+                metrics.inc("lease_expirations_total", len(expired))
+                for identity in sorted(expired):
+                    log.warning(
+                        "worker %s lease expired (no renewal in %.0fs "
+                        "with the store reachable); declaring dead",
+                        identity, self.lease_timeout)
+                    self._known_identities.pop(identity, None)
+                    self._lease_seen.pop(identity, None)
+            missing_workers = {
+                f"{s.hostname}:{s.local_rank}" for s in self._slots
+            } - set(self._known_identities)
+        if not changed and not missing_workers and not reset_reasons:
+            return
+        if self.reset_limit is not None and \
+                self.resets >= self.reset_limit:
+            msg = (f"elastic reset limit {self.reset_limit} reached; "
+                   "stopping job (reference RESET_LIMIT_EXCEEDED)")
+            log.error(msg)
+            self.stop(error_message=msg)
+            return
+        if self.hosts.total_slots() < self.min_np:
+            log.warning("host change leaves fewer than min_np slots; "
+                        "waiting for capacity")
+            return
+        # A worker-initiated reset (e.g. corruption abort with every
+        # process still alive) is removal-LIKE for sync purposes: the
+        # workers rolled back and must state.sync() after the reset.
+        removalish = removal or bool(missing_workers) \
+            or bool(reset_reasons)
+        # Cause precedence mirrors the judgment order above: an expired
+        # lease explains the missing worker it produced, a reset request
+        # means everyone is alive, worker_exit is a death the exit
+        # monitor saw first, host_change is pure discovery movement.
+        cause = ("lease_expiry" if expired else
+                 "reset_request" if reset_reasons else
+                 "worker_exit" if missing_workers else "host_change")
+        log.info("host set changed (removal=%s, dead_workers=%s, "
+                 "reset_requests=%s); advancing epoch",
+                 removal, sorted(missing_workers), reset_reasons)
+        self._rendezvous_epoch()
+        self._await_ack = not removalish  # remember flavor for re-notify
+        self._notify_workers(added_only=not removalish)
+        metrics.inc("driver_epoch_transitions_total", cause=cause)
+        flight_recorder.record(
+            "epoch_transition", epoch=self.epoch, cause=cause,
+            removal=removal, dead_workers=sorted(missing_workers),
+            reset_requests=reset_reasons)
+        if timeline_mod.control_active():
+            timeline_mod.control_span_since(
+                "driver", "CHURN_EVENT", t0_ns,
+                epoch=self.epoch, cause=cause)
+            timeline_mod.control_instant(
+                "driver", "EPOCH_TRANSITION", epoch=self.epoch, cause=cause)
+
+    def _push_driver_metrics(self) -> None:
+        """External-server deployments only: the driver's gauges and
+        counters live in the launcher process, which the (remote) server's
+        ``GET /metrics`` cannot see — push an epoch-stamped snapshot under
+        the reserved ``driver`` key, like a worker does.  The in-process
+        server snapshots this same registry directly; pushing there too
+        would double-count every series."""
+        if not metrics.ENABLED or \
+                not isinstance(self.rendezvous, ExternalRendezvous):
+            return
+        snap = metrics.registry.snapshot()
+        snap["rank"] = "driver"
+        snap["epoch"] = self.epoch
+        self.rendezvous.set(metrics.METRICS_SCOPE, "driver",
+                            json.dumps(snap).encode())
 
     def _pending_reset_requests(self) -> List[str]:
         """Worker-posted epoch-reset requests for the CURRENT epoch.
@@ -369,6 +429,7 @@ class ElasticDriver:
                           for s in self._slots}
         leased = set(self.rendezvous.keys(LEASE_SCOPE))
         expired: Set[str] = set()
+        min_ttl: Optional[float] = None
         for identity in sorted(identities & leased):
             raw = self.rendezvous.get(LEASE_SCOPE, identity)
             if raw is None:
@@ -376,14 +437,23 @@ class ElasticDriver:
             seen = self._lease_seen.get(identity)
             if seen is None or seen[0] != raw:
                 self._lease_seen[identity] = (raw, now)
-                continue
-            if now >= self._lease_grace_until and \
-                    now - seen[1] > self.lease_timeout:
-                expired.add(identity)
+                ttl = self.lease_timeout  # fresh renewal: full budget
+            else:
+                ttl = self.lease_timeout - (now - seen[1])
+                if now >= self._lease_grace_until and \
+                        now - seen[1] > self.lease_timeout:
+                    expired.add(identity)
+            if min_ttl is None or ttl < min_ttl:
+                min_ttl = ttl
         # Drop tracking for identities that left the slot table.
         for identity in list(self._lease_seen):
             if identity not in identities:
                 del self._lease_seen[identity]
+        if metrics.ENABLED:
+            metrics.set_gauge("leases_live",
+                              len(self._lease_seen) - len(expired))
+            if min_ttl is not None:
+                metrics.set_gauge("lease_min_ttl_seconds", min_ttl)
         return expired
 
     def _store_outage(self, err: Exception) -> None:

@@ -111,6 +111,25 @@ def launch_elastic_job(args, command: List[str]) -> int:
     if args.reset_limit:
         extra[env_mod.HOROVOD_ELASTIC_RESET_LIMIT] = str(args.reset_limit)
 
+    # Driver lifecycle trace (docs/observability.md "Control-plane
+    # attribution"): when the operator asked for timelines, the launcher
+    # writes <path>.driver with the reserved driver pid — DRV_* tick/
+    # spawn spans and CHURN_EVENT windows, hvd-control-path's anchor.
+    # Same-host as the in-process server (offset 0); external servers
+    # are assumed clock-synced like any worker host without an estimate.
+    driver_timeline = None
+    timeline_path = env_mod.get_str(env_mod.HOROVOD_TIMELINE)
+    if timeline_path:
+        from ..core.timeline import DRIVER_TRACE_PID, Timeline
+
+        try:
+            driver_timeline = Timeline(
+                f"{timeline_path}.driver", rank=DRIVER_TRACE_PID,
+                clock_offset_ns=0, process_name="elastic driver")
+        except OSError as e:
+            log.warning("cannot write driver timeline %s.driver: %s",
+                        timeline_path, e)
+
     procs: Dict[str, subprocess.Popen] = {}
     pumps: List[_OutputPump] = []
     lock = threading.Lock()
@@ -186,3 +205,5 @@ def launch_elastic_job(args, command: List[str]) -> int:
                     proc.kill()
             sweep_dead_segments([proc.pid for proc in procs.values()])
         server.stop()
+        if driver_timeline is not None:
+            driver_timeline.close()

@@ -41,6 +41,7 @@ from urllib.parse import unquote
 from ..common import env as env_mod
 from ..common import faults
 from ..core import metrics as metrics_mod
+from ..core import timeline as timeline_mod
 from ..transport.store import KEYS_PSEUDO_SCOPE, DurableMemoryStore
 
 RANK_AND_SIZE_SCOPE = "rank_and_size"
@@ -52,6 +53,32 @@ class _Handler(BaseHTTPRequestHandler):
     # quiet by default
     def log_message(self, fmt, *args):  # noqa: D102
         pass
+
+    # -- request observability (docs/observability.md "Control-plane
+    #    attribution"): every handler brackets its body with
+    #    _obs_begin/_obs_end, so each request lands one latency sample
+    #    (labeled op=), one per-scope op count, an in-flight gauge
+    #    update, and — when the server writes its own trace — an RV_*
+    #    span.  The server is the clock base trace_merge aligns against,
+    #    so those spans merge with worker traces unshifted.
+
+    def _obs_begin(self) -> int:
+        if metrics_mod.ENABLED:
+            self.server.inflight_delta(1)
+        return time.monotonic_ns()
+
+    def _obs_end(self, t0_ns: int, op: str, scope: str) -> None:
+        if metrics_mod.ENABLED:
+            metrics_mod.observe(
+                "rendezvous_request_seconds",
+                (time.monotonic_ns() - t0_ns) / 1e9, op=op)
+            metrics_mod.inc("rendezvous_scope_ops_total",
+                            scope=scope, op=op)
+            self.server.inflight_delta(-1)
+        tl = self.server.timeline
+        if tl is not None and timeline_mod.CONTROL_PLANE_ENABLED:
+            tl.span_since(f"rv_{op}", "RV_" + op.upper(), t0_ns,
+                          {"scope": scope})
 
     def _parse(self) -> Optional[Tuple[str, str]]:
         parts = [unquote(p) for p in self.path.split("/") if p]
@@ -76,18 +103,23 @@ class _Handler(BaseHTTPRequestHandler):
         return ok
 
     def do_PUT(self):
-        parsed = self._parse()
-        if parsed is None:
-            return
-        scope, key = parsed
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length)
-        if not self._authorized(body):
-            return
-        self.server.store_set(scope, key, body)
-        self.send_response(200)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+        t0 = self._obs_begin()
+        scope = "?"
+        try:
+            parsed = self._parse()
+            if parsed is None:
+                return
+            scope, key = parsed
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if not self._authorized(body):
+                return
+            self.server.store_set(scope, key, body)
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        finally:
+            self._obs_end(t0, "put", scope)
 
     def _reply(self, body: bytes, content_type: str) -> None:
         self.send_response(200)
@@ -124,6 +156,15 @@ class _Handler(BaseHTTPRequestHandler):
                 snaps = {k: s for k, s in snaps.items()
                          if not isinstance(s, dict)
                          or s.get("epoch", 0) == newest}
+            # Fold in the server process's OWN registry (request spans,
+            # lock waits, journal metrics — and, for the in-process
+            # deployment, the driver's lease/tick series, which live in
+            # the same process) under the reserved "server" rank label.
+            # Added after the epoch gate: the server is never stale.
+            if metrics_mod.ENABLED:
+                local = metrics_mod.registry.snapshot()
+                local["rank"] = "server"
+                snaps["server"] = local
             if "format=json" in query:
                 self._reply(json.dumps(snaps).encode(), "application/json")
             else:
@@ -137,40 +178,56 @@ class _Handler(BaseHTTPRequestHandler):
         # (on the standalone server) action=exit for a mid-serve kill.
         if faults.ACTIVE:
             faults.inject("store.get_serve")
-        if self._serve_special_get():
-            return
-        parsed = self._parse()
-        if parsed is None:
-            return
-        scope, key = parsed
-        if not self._authorized(b""):
-            return
-        if scope == KEYS_PSEUDO_SCOPE:
-            # GET /__keys__/<scope>: scope enumeration (signed — the key
-            # list leaks membership, unlike the aggregate /metrics view).
-            self._reply(json.dumps(sorted(
-                self.server.store_keys(key))).encode(), "application/json")
-            return
-        val = self.server.store_get(scope, key)
-        if val is None:
-            self.send_error(404, "no such key")
-            return
-        self.send_response(200)
-        self.send_header("Content-Length", str(len(val)))
-        self.end_headers()
-        self.wfile.write(val)
+        t0 = self._obs_begin()
+        op, scope = "get", "?"
+        try:
+            special = self.path.partition("?")[0]
+            if special in ("/clock", "/metrics"):
+                op, scope = special[1:], "-"
+                self._serve_special_get()
+                return
+            parsed = self._parse()
+            if parsed is None:
+                return
+            scope, key = parsed
+            if not self._authorized(b""):
+                return
+            if scope == KEYS_PSEUDO_SCOPE:
+                # GET /__keys__/<scope>: scope enumeration (signed — the
+                # key list leaks membership, unlike the aggregate
+                # /metrics view).
+                op, scope = "keys", key
+                self._reply(json.dumps(sorted(
+                    self.server.store_keys(key))).encode(),
+                    "application/json")
+                return
+            val = self.server.store_get(scope, key)
+            if val is None:
+                self.send_error(404, "no such key")
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(val)))
+            self.end_headers()
+            self.wfile.write(val)
+        finally:
+            self._obs_end(t0, op, scope)
 
     def do_DELETE(self):
-        parsed = self._parse()
-        if parsed is None:
-            return
-        scope, key = parsed
-        if not self._authorized(b""):
-            return
-        existed = self.server.store_delete(scope, key)
-        self.send_response(200 if existed else 404)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+        t0 = self._obs_begin()
+        scope = "?"
+        try:
+            parsed = self._parse()
+            if parsed is None:
+                return
+            scope, key = parsed
+            if not self._authorized(b""):
+                return
+            existed = self.server.store_delete(scope, key)
+            self.send_response(200 if existed else 404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        finally:
+            self._obs_end(t0, "delete", scope)
 
 
 class _KVServer(ThreadingHTTPServer):
@@ -182,14 +239,26 @@ class _KVServer(ThreadingHTTPServer):
     request_queue_size = 128
 
     def __init__(self, addr, delete_hook=None, job_secret=None,
-                 journal_dir=None):
+                 journal_dir=None, timeline=None):
         super().__init__(addr, _Handler)
         # Compose the canonical store so storage semantics (keying,
         # locking, journaling) live in exactly one place
         # (transport/store.py); journal_dir=None means plain in-memory.
-        self._store = DurableMemoryStore(journal_dir)
+        self._store = DurableMemoryStore(journal_dir, timeline=timeline)
+        self._store.enable_observability(timeline)
         self._delete_hook = delete_hook
         self.job_secret = job_secret
+        self.timeline = timeline
+        # In-flight request count; its lock is a leaf (gauge recorded
+        # after release).
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def inflight_delta(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._inflight += delta
+            current = self._inflight
+        metrics_mod.set_gauge("rendezvous_requests_in_flight", current)
 
     def server_close(self):
         super().server_close()
@@ -218,7 +287,8 @@ class RendezvousServer:
     def __init__(self, bind_addr: str = "0.0.0.0",
                  delete_hook: Optional[Callable[[str, str], None]] = None,
                  job_secret: Optional[bytes] = None,
-                 journal_dir: Optional[str] = None):
+                 journal_dir: Optional[str] = None,
+                 trace_path: Optional[str] = None):
         self._bind_addr = bind_addr
         self._server: Optional[_KVServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -228,11 +298,28 @@ class RendezvousServer:
             journal_dir = env_mod.get_str(
                 env_mod.HOROVOD_RENDEZVOUS_JOURNAL_DIR) or None
         self._journal_dir = journal_dir
+        if trace_path is None:
+            trace_path = env_mod.get_str(
+                env_mod.HOROVOD_SERVER_TIMELINE) or None
+        self._trace_path = trace_path
+        self._timeline = None
 
     def start(self, port: int = 0) -> int:
+        if self._trace_path:
+            from ..core.timeline import SERVER_TRACE_PID, Timeline
+
+            # The server IS trace_merge's clock base: offset 0 by
+            # definition, so its spans merge with worker traces
+            # unshifted.  activate=False — in the in-process deployment
+            # this object lives next to the launcher's own timeline and
+            # must not hijack the module ACTIVE slot.
+            self._timeline = Timeline(
+                self._trace_path, rank=SERVER_TRACE_PID, clock_offset_ns=0,
+                activate=False, process_name="rendezvous server")
         self._server = _KVServer((self._bind_addr, port), self._delete_hook,
                                  job_secret=self._job_secret,
-                                 journal_dir=self._journal_dir)
+                                 journal_dir=self._journal_dir,
+                                 timeline=self._timeline)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="rendezvous-http", daemon=True)
         self._thread.start()
@@ -273,6 +360,9 @@ class RendezvousServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self._timeline is not None:
+            self._timeline.close()
+            self._timeline = None
 
 
 class ExternalRendezvous:
@@ -340,11 +430,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="journal/snapshot directory (default: "
                              "HOROVOD_RENDEZVOUS_JOURNAL_DIR; empty = "
                              "no durability)")
+    parser.add_argument("--trace", default=None,
+                        help="write the server's own timeline trace here "
+                             "(default: HOROVOD_SERVER_TIMELINE; merges "
+                             "with worker traces via hvd-trace-merge)")
     args = parser.parse_args(argv)
 
     server = RendezvousServer(bind_addr=args.bind,
                               job_secret=secret_mod.job_secret(),
-                              journal_dir=args.journal_dir)
+                              journal_dir=args.journal_dir,
+                              trace_path=args.trace)
     port = server.start(args.port)
     print(f"rendezvous serving on port {port}", flush=True)
     try:

@@ -40,13 +40,34 @@ def fetch(addr: str, port: int, fmt: str = "text",
         return r.read().decode()
 
 
+# Control-plane snapshots (docs/observability.md "Control-plane
+# attribution"): the server folds its own registry into the scrape under
+# rank="server", and in external mode the driver pushes rank="driver".
+# Render them as a distinct section after the worker ranks.
+_CONTROL_RANKS = frozenset({"server", "driver"})
+
+
+def _order(snaps: dict):
+    def key_fn(key):
+        rank = str(snaps[key].get("rank", key))
+        return (1, rank) if rank in _CONTROL_RANKS else (0, str(key))
+    return sorted(snaps, key=key_fn)
+
+
+def _header(snap: dict, key, suffix: str) -> str:
+    rank = snap.get("rank", key)
+    if str(rank) in _CONTROL_RANKS:
+        return f"== control plane: {rank}{suffix} =="
+    return f"== rank {rank}{suffix} =="
+
+
 def _pretty(snaps: dict) -> str:
     out = []
-    for key in sorted(snaps, key=str):
+    for key in _order(snaps):
         snap = snaps[key]
-        rank = snap.get("rank", key)
-        out.append(f"== rank {rank} (pushed at unix_ns="
-                   f"{snap.get('ts_unix_ns', '?')}) ==")
+        out.append(_header(
+            snap, key,
+            f" (pushed at unix_ns={snap.get('ts_unix_ns', '?')})"))
         for kind in ("counters", "gauges"):
             for name in sorted(snap.get(kind, {})):
                 out.append(f"  {name} = {snap[kind][name]}")
@@ -63,11 +84,10 @@ def _rates(prev: dict, cur: dict, dt: float) -> str:
     """Per-second counter deltas between two snapshot scrapes (gauges are
     levels, not rates — shown as their current value)."""
     out = []
-    for key in sorted(cur, key=str):
+    for key in _order(cur):
         snap = cur[key]
         before = prev.get(key, {})
-        rank = snap.get("rank", key)
-        out.append(f"== rank {rank} (Δ over {dt:.1f}s) ==")
+        out.append(_header(snap, key, f" (Δ over {dt:.1f}s)"))
         prev_c = before.get("counters", {})
         for name in sorted(snap.get("counters", {})):
             d = snap["counters"][name] - prev_c.get(name, 0)

@@ -51,10 +51,13 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..common.logging_util import get_logger
+from ..core import metrics
+from ..core import timeline as timeline_mod
 
 log = get_logger("horovod_tpu.transport.journal")
 
@@ -120,7 +123,8 @@ class StoreJournal:
     """
 
     def __init__(self, dirpath: str, fsync: bool = True,
-                 snapshot_every: int = 512):
+                 snapshot_every: int = 512,
+                 trace: Optional["timeline_mod.Timeline"] = None):
         self._dir = dirpath
         self._fsync = fsync
         self._snapshot_every = max(1, int(snapshot_every))
@@ -128,6 +132,9 @@ class StoreJournal:
         self._fh = None
         self._gen = 0
         self._ops_since_snap = 0
+        # Server-side trace (JR_* spans); metrics/trace recording happens
+        # AFTER _lock is released so the leaf invariant holds.
+        self._trace = trace
         os.makedirs(dirpath, exist_ok=True)
 
     # -- paths ---------------------------------------------------------
@@ -155,11 +162,14 @@ class StoreJournal:
         """Replay to the pre-crash KV state and arm the journal for
         appends (truncating any torn tail first).  Call exactly once,
         before the first append."""
+        t0 = time.monotonic_ns()
+        truncated = False
         with self._lock:
             state, gen, valid_len, nops = self._recover_locked()
             self._gen = gen
             jpath = self._journal_path(gen)
             if os.path.exists(jpath) and os.path.getsize(jpath) > valid_len:
+                truncated = True
                 torn = os.path.getsize(jpath) - valid_len
                 log.warning("journal %s: truncating %d-byte torn tail "
                             "(replayed %d ops)", jpath, torn, nops)
@@ -172,7 +182,16 @@ class StoreJournal:
             if state or nops:
                 log.info("rendezvous journal recovered: generation %d, "
                          "%d keys, %d journal ops", gen, len(state), nops)
-            return state
+        if metrics.ENABLED:
+            metrics.observe("journal_replay_seconds",
+                            (time.monotonic_ns() - t0) / 1e9)
+            if truncated:
+                metrics.inc("journal_truncated_tails_total")
+            metrics.set_gauge("journal_generation", self._gen)
+        if self._trace is not None and timeline_mod.CONTROL_PLANE_ENABLED:
+            self._trace.span_since("journal", "JR_REPLAY", t0,
+                                   {"generation": self._gen, "ops": nops})
+        return state
 
     def _recover_locked(self) -> Tuple[Dict[str, bytes], int, int, int]:
         for gen in sorted(self._generations(), reverse=True) or [0]:
@@ -255,37 +274,69 @@ class StoreJournal:
             self._fh.write(pack_frame(JOURNAL_MAGIC))
             self._sync_locked()
 
-    def _sync_locked(self) -> None:
+    def _sync_locked(self) -> float:
+        """Flush (+ fsync under the default policy); returns the fsync
+        wall seconds (0.0 when fsync is off)."""
         self._fh.flush()
-        if self._fsync:
-            os.fsync(self._fh.fileno())
+        if not self._fsync:
+            return 0.0
+        t0 = time.monotonic_ns()
+        os.fsync(self._fh.fileno())
+        return (time.monotonic_ns() - t0) / 1e9
+
+    def _record_append(self, t0_ns: int, fsync_s: float) -> None:
+        """Metrics + trace for one append, called with ``_lock`` already
+        released (leaf discipline); the store's condition lock may still
+        be held — both sinks are terminal locks, no new order edges."""
+        if metrics.ENABLED:
+            metrics.observe("journal_append_seconds",
+                            (time.monotonic_ns() - t0_ns) / 1e9)
+            if fsync_s > 0.0:
+                metrics.observe("journal_fsync_seconds", fsync_s)
+        tr = self._trace
+        if tr is not None and fsync_s > 0.0 \
+                and timeline_mod.CONTROL_PLANE_ENABLED:
+            tr.span_since("journal", "JR_FSYNC",
+                          time.monotonic_ns() - int(fsync_s * 1e9))
 
     def append_set(self, key: str, value: bytes) -> None:
+        t0 = time.monotonic_ns()
         with self._lock:
             if self._fh is None:
                 return  # closed (server shutdown race): drop silently
             self._fh.write(pack_frame(encode_op(OP_SET, key, value)))
-            self._sync_locked()
+            fsync_s = self._sync_locked()
             self._ops_since_snap += 1
+        self._record_append(t0, fsync_s)
 
     def append_delete(self, key: str) -> None:
+        t0 = time.monotonic_ns()
         with self._lock:
             if self._fh is None:
                 return
             self._fh.write(pack_frame(encode_op(OP_DELETE, key)))
-            self._sync_locked()
+            fsync_s = self._sync_locked()
             self._ops_since_snap += 1
+        self._record_append(t0, fsync_s)
 
     def maybe_compact(self, state: Dict[str, bytes]) -> bool:
         """Compact when the op budget is spent; ``state`` is the full
         post-op KV map (the caller holds the store lock, so it cannot
         move underneath).  Returns whether a compaction ran."""
+        t0 = time.monotonic_ns()
         with self._lock:
             if self._fh is None or \
                     self._ops_since_snap < self._snapshot_every:
                 return False
             self._compact_locked(state)
-            return True
+        if metrics.ENABLED:
+            metrics.observe("journal_compaction_seconds",
+                            (time.monotonic_ns() - t0) / 1e9)
+            metrics.set_gauge("journal_generation", self._gen)
+        if self._trace is not None and timeline_mod.CONTROL_PLANE_ENABLED:
+            self._trace.span_since("journal", "JR_COMPACT", t0,
+                                   {"generation": self._gen})
+        return True
 
     def _compact_locked(self, state: Dict[str, bytes]) -> None:
         new_gen = self._gen + 1

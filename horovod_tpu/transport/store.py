@@ -76,15 +76,60 @@ class MemoryStore(Store):
     def __init__(self):
         self._data: Dict[str, bytes] = {}
         self._cv = threading.Condition()
+        # Server-side observability (runner/rendezvous.py enables it on
+        # the store backing the KV server): lock-acquire waits become the
+        # rendezvous_store_lock_wait_seconds histogram + RV_LOCK_WAIT
+        # server-trace spans.  Worker/test stores keep the bare acquire.
+        self._observed = False
+        self._trace = None
+
+    def enable_observability(self, trace=None) -> None:
+        self._observed = True
+        self._trace = trace
+
+    def _acquire(self) -> None:
+        """Acquire the store lock, timing the wait when observed.  Pair
+        with ``self._cv.release()`` (callers use try/finally).  Recording
+        happens while holding the lock — metrics registry and timeline
+        locks are both terminal, so no new lock-order edges."""
+        if not self._observed:
+            self._cv.acquire()
+            return
+        from ..core import metrics
+        from ..core import timeline as timeline_mod
+
+        if not metrics.ENABLED and self._trace is None:
+            # HOROVOD_METRICS=0 and no server trace: stay a bare acquire
+            # (the churn-sim A/B overhead guard measures this arm).
+            self._cv.acquire()
+            return
+        t0 = time.monotonic_ns()
+        self._cv.acquire()
+        wait_s = (time.monotonic_ns() - t0) / 1e9
+        if metrics.ENABLED:
+            metrics.observe("rendezvous_store_lock_wait_seconds", wait_s)
+        tr = self._trace
+        if tr is not None and wait_s >= 50e-6 \
+                and timeline_mod.CONTROL_PLANE_ENABLED:
+            # Sub-50µs uncontended acquires would flood the trace; the
+            # skipped slivers sit inside the covering RV_* request span,
+            # so hvd-control-path attribution loses nothing.
+            tr.span_since("store_lock", "RV_LOCK_WAIT", t0)
 
     def set(self, scope: str, key: str, value: bytes) -> None:
-        with self._cv:
+        self._acquire()
+        try:
             self._data[f"{scope}/{key}"] = value
             self._cv.notify_all()
+        finally:
+            self._cv.release()
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        with self._cv:
+        self._acquire()
+        try:
             return self._data.get(f"{scope}/{key}")
+        finally:
+            self._cv.release()
 
     def delete(self, scope: str, key: str) -> None:
         self.pop(scope, key)
@@ -92,16 +137,22 @@ class MemoryStore(Store):
     def pop(self, scope: str, key: str) -> Optional[bytes]:
         """Atomic check-and-delete (one lock) — callers that need to know
         whether the key existed must use this, not get()+delete()."""
-        with self._cv:
+        self._acquire()
+        try:
             return self._data.pop(f"{scope}/{key}", None)
+        finally:
+            self._cv.release()
 
     def keys(self, scope: str) -> List[str]:
         """All keys currently present in a scope (driver-side enumeration
         of dynamically-registered workers)."""
         prefix = f"{scope}/"
-        with self._cv:
+        self._acquire()
+        try:
             return [k[len(prefix):] for k in self._data
                     if k.startswith(prefix)]
+        finally:
+            self._cv.release()
 
 
 class DurableMemoryStore(MemoryStore):
@@ -120,7 +171,8 @@ class DurableMemoryStore(MemoryStore):
 
     def __init__(self, journal_dir: Optional[str] = None,
                  fsync: Optional[bool] = None,
-                 snapshot_every: Optional[int] = None):
+                 snapshot_every: Optional[int] = None,
+                 timeline=None):
         super().__init__()
         self._journal = None
         if not journal_dir:
@@ -136,7 +188,8 @@ class DurableMemoryStore(MemoryStore):
                 env_mod.HOROVOD_RENDEZVOUS_SNAPSHOT_EVERY,
                 env_mod.DEFAULT_RENDEZVOUS_SNAPSHOT_EVERY)
         self._journal = StoreJournal(journal_dir, fsync=fsync,
-                                     snapshot_every=snapshot_every)
+                                     snapshot_every=snapshot_every,
+                                     trace=timeline)
         recovered = self._journal.recover()
         with self._cv:
             self._data.update(recovered)
@@ -144,17 +197,21 @@ class DurableMemoryStore(MemoryStore):
     def set(self, scope: str, key: str, value: bytes) -> None:
         if self._journal is None:
             return super().set(scope, key, value)
-        with self._cv:
+        self._acquire()
+        try:
             flat = f"{scope}/{key}"
             self._journal.append_set(flat, value)
             self._data[flat] = value
             self._journal.maybe_compact(self._data)
             self._cv.notify_all()
+        finally:
+            self._cv.release()
 
     def pop(self, scope: str, key: str) -> Optional[bytes]:
         if self._journal is None:
             return super().pop(scope, key)
-        with self._cv:
+        self._acquire()
+        try:
             flat = f"{scope}/{key}"
             if flat not in self._data:
                 return None  # no journal record for a no-op delete
@@ -162,6 +219,8 @@ class DurableMemoryStore(MemoryStore):
             value = self._data.pop(flat)
             self._journal.maybe_compact(self._data)
             return value
+        finally:
+            self._cv.release()
 
     def close(self) -> None:
         if self._journal is not None:
@@ -218,20 +277,30 @@ class HTTPStoreClient(Store):
     def set(self, scope: str, key: str, value: bytes) -> None:
         from ..common import faults
         from ..core import metrics
+        from ..core import timeline as timeline_mod
 
         if faults.ACTIVE:
             faults.inject("store.put")
         metrics.inc("rendezvous_store_ops_total", op="set")
-        with self._open_with_retry(self._request(scope, key, "PUT", value)):
-            pass
+        t0 = time.monotonic_ns() if timeline_mod.control_active() else None
+        try:
+            with self._open_with_retry(
+                    self._request(scope, key, "PUT", value)):
+                pass
+        finally:
+            if t0 is not None:
+                timeline_mod.control_span_since(
+                    "rendezvous_client", "RVC_SET", t0, scope=scope)
 
     def keys(self, scope: str) -> List[str]:
         """Enumerate a scope's keys (``GET /__keys__/<scope>``) — the
         driver-side lease scan and crash-recovery both need enumeration
         over the wire, which plain /scope/key GETs cannot express."""
         from ..core import metrics
+        from ..core import timeline as timeline_mod
 
         metrics.inc("rendezvous_store_ops_total", op="keys")
+        t0 = time.monotonic_ns() if timeline_mod.control_active() else None
         try:
             with self._open_with_retry(
                     self._request(KEYS_PSEUDO_SCOPE, scope, "GET")) as resp:
@@ -240,14 +309,20 @@ class HTTPStoreClient(Store):
             if e.code == 404:
                 return []  # pre-survivability server: treat as empty
             raise
+        finally:
+            if t0 is not None:
+                timeline_mod.control_span_since(
+                    "rendezvous_client", "RVC_KEYS", t0, scope=scope)
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         from ..common import faults
         from ..core import metrics
+        from ..core import timeline as timeline_mod
 
         if faults.ACTIVE:
             faults.inject("rendezvous.get")
         metrics.inc("rendezvous_store_ops_total", op="get")
+        t0 = time.monotonic_ns() if timeline_mod.control_active() else None
         try:
             with self._open_with_retry(
                     self._request(scope, key, "GET")) as resp:
@@ -256,11 +331,17 @@ class HTTPStoreClient(Store):
             if e.code == 404:
                 return None
             raise
+        finally:
+            if t0 is not None:
+                timeline_mod.control_span_since(
+                    "rendezvous_client", "RVC_GET", t0, scope=scope)
 
     def delete(self, scope: str, key: str) -> None:
         from ..core import metrics
+        from ..core import timeline as timeline_mod
 
         metrics.inc("rendezvous_store_ops_total", op="delete")
+        t0 = time.monotonic_ns() if timeline_mod.control_active() else None
         req = self._request(scope, key, "DELETE")
         try:
             with urllib.request.urlopen(req, timeout=self._timeout):
@@ -268,3 +349,7 @@ class HTTPStoreClient(Store):
         except urllib.error.HTTPError as e:
             if e.code != 404:
                 raise
+        finally:
+            if t0 is not None:
+                timeline_mod.control_span_since(
+                    "rendezvous_client", "RVC_DELETE", t0, scope=scope)

@@ -281,3 +281,30 @@ def test_driver_recover_is_noop_on_fresh_store(tmp_path):
     assert driver.recover_from_store() is False
     assert driver.epoch == 0
     server.stop()
+
+
+# ---------------------------------------------------------------------------
+# control-plane attribution (docs/observability.md)
+
+
+def test_churn_attribution_covers_90pct_at_np8():
+    """Acceptance floor for hvd-control-path: over a real np=8 churn run
+    (traced server + traced driver-side client), the disjoint phase carve
+    must explain at least 90% of every churn event's wall time."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "controller_sim", os.path.join(
+            os.path.dirname(__file__), "..", "benchmarks",
+            "controller_sim.py"))
+    controller_sim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(controller_sim)
+
+    rec = controller_sim.run_churn_case(8, events=3, trace=True)
+    attr = rec["attribution"]
+    assert attr["coverage"] >= 0.90, attr
+    # The carve must name the dominant cost, not dump it in one bucket:
+    # churn is HTTP round-trips with a real journal-fsync share.
+    assert attr["phase_share"]["http_roundtrip"] > 0.3, attr
+    assert attr["phase_share"]["journal_fsync"] > 0.0, attr

@@ -727,6 +727,18 @@ def test_dead_worker_lease_expiry_advances_epoch_within_one_tick():
         assert ("localhost:0", 1) not in spawned, spawned
         assert metrics_mod.registry.get_counter(
             "lease_expirations_total") >= expirations_before + 1
+        # The transition itself must be attributable after the fact: a
+        # cause-tagged flight-recorder event and counter (the driver runs
+        # in this process, so both are inspectable directly).
+        from horovod_tpu.core import flight_recorder
+
+        trans = [e for e in flight_recorder.recorder.events()
+                 if e.get("kind") == "epoch_transition"]
+        assert trans, "driver recorded no epoch_transition event"
+        assert trans[-1]["cause"] == "lease_expiry", trans[-1]
+        assert "127.0.0.1:0" in trans[-1]["dead_workers"], trans[-1]
+        assert metrics_mod.registry.get_counter(
+            "driver_epoch_transitions_total", cause="lease_expiry") >= 1
     finally:
         stop_renewals.set()
         driver.stop()

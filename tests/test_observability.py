@@ -245,6 +245,82 @@ def test_scrape_serves_only_newest_epoch():
         server.stop()
 
 
+@pytest.mark.smoke
+def test_server_request_metrics_and_scrape_fold_in():
+    """Control-plane attribution, server side: every HTTP op lands in the
+    per-op latency histogram and per-scope counters, and ``GET /metrics``
+    folds the server's own registry into the scrape under rank="server"
+    (never epoch-gated — the server can't be stale about itself)."""
+    import urllib.request
+
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    from horovod_tpu.transport.store import HTTPStoreClient
+
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    try:
+        reg = metrics.registry
+        puts0 = reg.get_counter("rendezvous_scope_ops_total",
+                                op="put", scope="obs-smoke")
+        client = HTTPStoreClient("127.0.0.1", port)
+        client.set("obs-smoke", "k", b"v")
+        client.get("obs-smoke", "k")
+        client.keys("obs-smoke")
+        assert reg.get_counter("rendezvous_scope_ops_total",
+                               op="put", scope="obs-smoke") == puts0 + 1
+        assert reg.get_counter("rendezvous_scope_ops_total",
+                               op="keys", scope="obs-smoke") >= 1
+        hists = reg.snapshot()["histograms"]
+        for op in ("put", "get", "keys"):
+            key = metrics.flat("rendezvous_request_seconds", op=op)
+            assert hists.get(key, {}).get("count", 0) >= 1, (key, op)
+        # the in-flight gauge settled back to 0 after the burst
+        assert reg.get_gauge("rendezvous_requests_in_flight") == 0
+        # store-lock wait is observed on every guarded acquire
+        lock_key = metrics.flat("rendezvous_store_lock_wait_seconds")
+        assert hists.get(lock_key, {}).get("count", 0) >= 1
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert 'rank="server"' in text, text[:2000]
+        assert "hvd_rendezvous_request_seconds" in text
+    finally:
+        server.stop()
+
+
+@pytest.mark.smoke
+def test_journal_metrics(tmp_path):
+    """Durability-plane attribution: appends/fsyncs/replay/compaction all
+    observe, torn tails count, and the generation gauge tracks."""
+    from horovod_tpu.transport.store import DurableMemoryStore
+
+    def hist_count(name):
+        h = metrics.registry.snapshot()["histograms"]
+        return h.get(metrics.flat(name), {}).get("count", 0)
+
+    appends0 = hist_count("journal_append_seconds")
+    fsyncs0 = hist_count("journal_fsync_seconds")
+    store = DurableMemoryStore(str(tmp_path))
+    store.set("s", "k", b"v")
+    store.pop("s", "k")
+    store.close()
+    assert hist_count("journal_append_seconds") == appends0 + 2
+    assert hist_count("journal_fsync_seconds") >= fsyncs0 + 2
+    assert metrics.registry.get_gauge("journal_generation") == 0
+
+    # A recover replays (and times) the journal; garbage appended after
+    # the valid prefix is a torn tail and must increment the counter.
+    replays0 = hist_count("journal_replay_seconds")
+    torn0 = metrics.registry.get_counter("journal_truncated_tails_total")
+    jpath = tmp_path / "journal-00000000"
+    with open(jpath, "ab") as f:
+        f.write(b"\x01torn-garbage")
+    store2 = DurableMemoryStore(str(tmp_path))
+    store2.close()
+    assert hist_count("journal_replay_seconds") == replays0 + 1
+    assert metrics.registry.get_counter(
+        "journal_truncated_tails_total") == torn0 + 1
+
+
 # ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
@@ -533,6 +609,63 @@ class TestTraceMerge:
         merged = json.loads(out.read_text())
         assert {e.get("pid") for e in merged if e.get("ph") == "B"} == {0, 1}
 
+    def test_server_trace_merges_unshifted(self):
+        """The server is trace_merge's clock base: its own trace carries
+        offset 0, so when it is the earliest input its spans merge with
+        shift 0 while worker spans are rebased onto its axis."""
+        from horovod_tpu.core.timeline import SERVER_TRACE_PID
+        from horovod_tpu.tools import trace_merge
+
+        server = _trace(SERVER_TRACE_PID, 1_000_000_000, 0,
+                        [{"name": "RV_PUT", "ph": "X",
+                          "pid": SERVER_TRACE_PID, "tid": 1,
+                          "ts": 40.0, "dur": 10.0}])
+        # Worker wall clock runs 7 ms ahead; it started 2 ms of server
+        # time after the server's trace began.
+        worker = _trace(0, 1_000_000_000 + 9_000_000, 7_000_000,
+                        [{"name": "RVC_SET", "ph": "X", "pid": 0,
+                          "tid": 1, "ts": 40.0, "dur": 30.0}])
+        merged = trace_merge.merge([server, worker])
+        ts = {e["pid"]: e["ts"] for e in merged if e.get("ph") == "X"}
+        assert ts[SERVER_TRACE_PID] == pytest.approx(40.0)
+        assert ts[0] == pytest.approx(40.0 + 2_000.0)
+
+    def test_live_server_trace_lane_and_crash_repair(self, tmp_path):
+        """A real traced server: RV_* spans land on the reserved server
+        pid with a zero-offset clock_sync, and a crash-truncated copy of
+        the file repairs to a valid prefix on load."""
+        from horovod_tpu.core.timeline import SERVER_TRACE_PID
+        from horovod_tpu.runner.rendezvous import RendezvousServer
+        from horovod_tpu.transport.store import HTTPStoreClient
+        from horovod_tpu.tools import trace_merge
+
+        path = tmp_path / "server.json"
+        server = RendezvousServer("127.0.0.1", trace_path=str(path))
+        port = server.start()
+        try:
+            client = HTTPStoreClient("127.0.0.1", port)
+            for i in range(4):
+                client.set("scope", f"k{i}", b"v")
+            client.keys("scope")
+            client.get("scope", "k0")
+        finally:
+            server.stop()
+        events = trace_merge.load_trace(str(path))
+        spans = [e for e in events if e.get("ph") == "X"]
+        names = {e["name"] for e in spans}
+        assert {"RV_PUT", "RV_KEYS", "RV_GET"} <= names, names
+        assert {e["pid"] for e in spans} == {SERVER_TRACE_PID}
+        sync = trace_merge._clock_sync(events)
+        assert sync is not None and sync[1] == SERVER_TRACE_PID
+        # Crash contract: cut mid-record (a SIGKILL'd server never writes
+        # the closing bracket) and the loader keeps the valid prefix.
+        text = path.read_text()
+        trunc = tmp_path / "trunc.json"
+        trunc.write_text(text[:text.rindex("{") + 10])
+        repaired = trace_merge.load_trace(str(trunc))
+        assert 0 < len(repaired) < len(events)
+        assert all(isinstance(e, dict) for e in repaired)
+
 
 # ---------------------------------------------------------------------------
 # critical-path extraction
@@ -646,6 +779,105 @@ class TestCriticalPath:
                                      _cp_ev("X", "E", 0, 1, 9)])
         assert doc["steps"] == []
         assert "HOROVOD_TIMELINE" in critical_path.render_text(doc)
+
+
+# ---------------------------------------------------------------------------
+# control-path attribution (hvd-control-path)
+# ---------------------------------------------------------------------------
+
+
+def _x(name, pid, ts, dur, **args):
+    e = {"name": name, "ph": "X", "pid": pid, "tid": 1,
+         "ts": float(ts), "dur": float(dur)}
+    if args:
+        e["args"] = args
+    return e
+
+
+def _churn_events():
+    """One churn event window 0..100 µs: a 40 µs client round-trip with a
+    server handler, lock wait, and fsync nested inside, plus a respawn."""
+    from horovod_tpu.core.timeline import DRIVER_TRACE_PID, SERVER_TRACE_PID
+
+    d, s = DRIVER_TRACE_PID, SERVER_TRACE_PID
+    return [
+        _x("CHURN_EVENT", d, 0, 100, cause="lease_expiry", epoch=3),
+        _x("RVC_SET", d, 10, 40, scope="lease"),
+        _x("RV_PUT", s, 15, 30, scope="lease"),
+        _x("RV_LOCK_WAIT", s, 20, 10),
+        _x("JR_FSYNC", s, 30, 10),
+        _x("DRV_SPAWN", d, 60, 30),
+    ]
+
+
+@pytest.mark.smoke
+class TestControlPath:
+    def test_disjoint_carve_and_coverage(self):
+        from horovod_tpu.tools import control_path
+
+        doc = control_path.analyze(_churn_events())
+        assert doc["format"] == "hvd-control-path-v1"
+        (ev,) = doc["events"]
+        assert ev["cause"] == "lease_expiry" and ev["epoch"] == 3
+        ph = ev["phases_us"]
+        # The lock wait and fsync nest inside the HTTP round-trip: they
+        # keep their own phase, HTTP only keeps what they don't explain.
+        assert ph["store_lock_wait"] == 10.0       # 20..30
+        assert ph["journal_fsync"] == 10.0         # 30..40
+        assert ph["http_roundtrip"] == 20.0        # 10..50 minus 20..40
+        assert ph["respawn"] == 30.0               # 60..90
+        assert ph["driver_tick_wait"] == 0.0
+        assert ev["unattributed_us"] == 30.0
+        assert ev["coverage"] == pytest.approx(0.7)
+        assert doc["coverage"] == pytest.approx(0.7)
+        assert doc["phase_share"]["respawn"] == pytest.approx(0.3)
+
+    def test_spans_clip_to_their_window(self):
+        from horovod_tpu.core.timeline import DRIVER_TRACE_PID
+        from horovod_tpu.tools import control_path
+
+        d = DRIVER_TRACE_PID
+        doc = control_path.analyze([
+            _x("CHURN_EVENT", d, 0, 100, cause="sim", epoch=1),
+            # straddles the window's end: only 80..100 may count
+            _x("RVC_GET", d, 80, 40, scope="lease"),
+        ])
+        (ev,) = doc["events"]
+        assert ev["phases_us"]["http_roundtrip"] == 20.0
+
+    def test_b_e_worker_spans_are_ignored(self):
+        from horovod_tpu.core.timeline import DRIVER_TRACE_PID
+        from horovod_tpu.tools import control_path
+
+        d = DRIVER_TRACE_PID
+        doc = control_path.analyze([
+            _x("CHURN_EVENT", d, 0, 100, cause="sim", epoch=1),
+            {"name": "ALLREDUCE", "ph": "B", "pid": 0, "tid": 1, "ts": 5},
+            {"name": "ALLREDUCE", "ph": "E", "pid": 0, "tid": 1, "ts": 95},
+        ])
+        (ev,) = doc["events"]
+        assert all(v == 0.0 for v in ev["phases_us"].values())
+
+    def test_empty_trace_renders_hint(self):
+        from horovod_tpu.tools import control_path
+
+        doc = control_path.analyze([])
+        assert doc["event_count"] == 0 and doc["coverage"] == 1.0
+        assert "CHURN_EVENT" in control_path.render_text(doc)
+
+    def test_cli_json_report(self, tmp_path, capsys):
+        from horovod_tpu.tools import control_path
+
+        trace = tmp_path / "merged.json"
+        trace.write_text(json.dumps(_churn_events()))
+        out = tmp_path / "cp.json"
+        rc = control_path.main([str(trace), "--json", str(out)])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["event_count"] == 1
+        text = capsys.readouterr().out
+        assert "coverage 70.0%" in text
+        assert "respawn" in text
 
 
 # ---------------------------------------------------------------------------

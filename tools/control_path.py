@@ -1,0 +1,13 @@
+#!/usr/bin/env python3
+"""Checkout-friendly shim: ``tools/control_path.py <traces...>`` runs
+``horovod_tpu.tools.control_path`` without installing the package."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.tools.control_path import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
