@@ -170,7 +170,8 @@ def _percentile(sorted_ms, frac):
                                len(sorted_ms) - 1)], 3)
 
 
-def run_churn_case(world: int, events: int, trace: bool = True) -> dict:
+def run_churn_case(world: int, events: int, trace: bool = True,
+                   batched: bool = False) -> dict:
     """One membership-churn baseline at world size N, end to end through
     the journaled rendezvous server (started in-process, driven over
     HTTP like a real driver would).
@@ -180,7 +181,15 @@ def run_churn_case(world: int, events: int, trace: bool = True) -> dict:
     sim drives the client under a driver-pid timeline (RVC_* round-trips
     plus one CHURN_EVENT window per event), and the merged traces are fed
     through ``hvd-control-path`` in-process — the record then carries an
-    ``attribution`` block saying where each event's wall time went."""
+    ``attribution`` block saying where each event's wall time went.
+
+    ``batched=True`` issues the op mix the way the post-ISSUE-15 driver
+    does — everything through ``client.batch`` (lease scan = one frame of
+    N gets, republish = one frame of N+1 puts, renewals = one frame of N
+    puts) — so the SAME call sites measure both protocols: with
+    ``HOROVOD_RENDEZVOUS_BATCH=0`` in the environment the client (and
+    server) fall back to per-op round-trips, which is exactly the control
+    arm the A/B mode uses."""
     import shutil
     import tempfile
 
@@ -205,24 +214,50 @@ def run_churn_case(world: int, events: int, trace: bool = True) -> dict:
                       process_name="churn driver (sim)")
     identities = [f"host{r:03d}:0" for r in range(world)]
 
-    def publish_table(epoch: int) -> None:
-        for rank, identity in enumerate(identities):
-            client.set("rank_and_size", identity, json.dumps({
-                "hostname": identity.split(":")[0], "rank": rank,
-                "local_rank": 0, "cross_rank": rank, "size": world,
-                "local_size": 1, "cross_size": world, "epoch": epoch,
-            }).encode())
-        client.set("driver", "epoch", str(epoch).encode())
+    def _slot(rank: int, identity: str, epoch: int) -> bytes:
+        return json.dumps({
+            "hostname": identity.split(":")[0], "rank": rank,
+            "local_rank": 0, "cross_rank": rank, "size": world,
+            "local_size": 1, "cross_size": world, "epoch": epoch,
+        }).encode()
 
-    def renew_leases(epoch: int, renewal: int) -> None:
-        for rank, identity in enumerate(identities):
-            client.set(LEASE_SCOPE, identity, json.dumps({
-                "rank": rank, "epoch": epoch,
-                "renewals": renewal}).encode())
+    def _lease(rank: int, epoch: int, renewal: int) -> bytes:
+        return json.dumps({"rank": rank, "epoch": epoch,
+                           "renewals": renewal}).encode()
 
-    def lease_scan() -> None:
-        for identity in client.keys(LEASE_SCOPE):
-            client.get(LEASE_SCOPE, identity)
+    if batched:
+        # The post-ISSUE-15 driver's shape: one /batch frame per phase
+        # (see ElasticDriver._tick_store_reads / _rendezvous_epoch).
+        def publish_table(epoch: int) -> None:
+            client.batch(
+                [("set", "rank_and_size", identity,
+                  _slot(rank, identity, epoch))
+                 for rank, identity in enumerate(identities)]
+                + [("set", "driver", "epoch", str(epoch).encode())])
+
+        def renew_leases(epoch: int, renewal: int) -> None:
+            client.batch([("set", LEASE_SCOPE, identity,
+                           _lease(rank, epoch, renewal))
+                          for rank, identity in enumerate(identities)])
+
+        def lease_scan() -> None:
+            client.batch([("get", LEASE_SCOPE, identity)
+                          for identity in identities])
+    else:
+        def publish_table(epoch: int) -> None:
+            for rank, identity in enumerate(identities):
+                client.set("rank_and_size", identity,
+                           _slot(rank, identity, epoch))
+            client.set("driver", "epoch", str(epoch).encode())
+
+        def renew_leases(epoch: int, renewal: int) -> None:
+            for rank, identity in enumerate(identities):
+                client.set(LEASE_SCOPE, identity,
+                           _lease(rank, epoch, renewal))
+
+        def lease_scan() -> None:
+            for identity in client.keys(LEASE_SCOPE):
+                client.get(LEASE_SCOPE, identity)
 
     t0 = time.perf_counter()
     publish_table(0)
@@ -289,6 +324,7 @@ def run_churn_case(world: int, events: int, trace: bool = True) -> dict:
         "metric": "controller_churn",
         "world_size": world,
         "events": events,
+        "batched": batched,
         "bringup_ms": round(bringup_ms, 3),
         "event_ms_p50": _percentile(event_ms, 0.5),
         "event_ms_p99": _percentile(event_ms, 0.99),
@@ -303,6 +339,45 @@ def run_churn_case(world: int, events: int, trace: bool = True) -> dict:
     if attribution is not None:
         rec["attribution"] = attribution
     return rec
+
+
+def run_churn_ab(world: int, events: int, repeats: int) -> dict:
+    """Interleaved batched-vs-per-op A/B at world size N through
+    ``ab_harness.ab_compare`` (paired sign test): both arms run the SAME
+    batched-style call sites; the control arm holds
+    ``HOROVOD_RENDEZVOUS_BATCH=0`` so server and client degrade to the
+    old per-op protocol.  The PR gate is verdict == "improvement" with
+    the batched arm >= 2x faster per churn event."""
+    from ab_harness import ab_compare
+
+    def measure(env) -> float:
+        saved = {}
+        for k, v in (env or {}).items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            rec = run_churn_case(world, events, trace=False, batched=True)
+            return rec["event_ms_p50"] / 1e3
+        finally:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+
+    doc = ab_compare(measure,
+                     control_env={"HOROVOD_RENDEZVOUS_BATCH": "0"},
+                     candidate_env={"HOROVOD_RENDEZVOUS_BATCH": "1"},
+                     repeats=repeats)
+    doc.update({
+        "metric": "controller_churn_batched_ab",
+        "world_size": world,
+        "events": events,
+        "label": "rendezvous-batch",
+        "speedup": round(doc["median_control_ms"]
+                         / max(doc["median_candidate_ms"], 1e-9), 2),
+    })
+    return doc
 
 
 def run_churn_overhead(world: int, events: int, rounds: int) -> dict:
@@ -346,8 +421,18 @@ def main() -> int:
                         "rendezvous server instead of the coordinator sim")
     p.add_argument("--events", type=int, default=20,
                    help="churn events per world size (--churn only)")
+    p.add_argument("--batched", action="store_true",
+                   help="drive the churn op mix through /batch "
+                        "transactions, one frame per phase, like the "
+                        "post-batching driver (--churn only)")
     p.add_argument("--no-trace", action="store_true",
                    help="skip trace capture + attribution (--churn only)")
+    p.add_argument("--ab-out", default=None, metavar="PATH",
+                   help="run the interleaved batched-vs-per-op A/B "
+                        "(ab_harness paired sign test) at the first "
+                        "world size and write the verdict record here "
+                        "(--churn only)")
+    p.add_argument("--ab-repeats", type=int, default=6)
     p.add_argument("--overhead-out", default=None, metavar="PATH",
                    help="instead of the churn sweep, run the interleaved "
                         "metrics on/off A/B at the first world size and "
@@ -355,6 +440,15 @@ def main() -> int:
     p.add_argument("--overhead-rounds", type=int, default=5)
     p.add_argument("--out", default=None)
     args = p.parse_args()
+
+    if args.churn and args.ab_out:
+        rec = run_churn_ab(args.world_sizes[0], args.events,
+                           args.ab_repeats)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(args.ab_out, "w") as f:
+            f.write(line + "\n")
+        return 0
 
     if args.churn and args.overhead_out:
         rec = run_churn_overhead(args.world_sizes[0], args.events,
@@ -369,7 +463,8 @@ def main() -> int:
     for world in args.world_sizes:
         if args.churn:
             rec = run_churn_case(world, args.events,
-                                 trace=not args.no_trace)
+                                 trace=not args.no_trace,
+                                 batched=args.batched)
         else:
             rec = run_case(world, args.tensors, args.cycles)
         line = json.dumps(rec)

@@ -38,4 +38,31 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fault_injection.py -m chaos \
     -v -p no:cacheprovider "$@" > ci/chaos.last.log 2>&1 || rc=$?
 cat ci/chaos.last.log
 [ "$rc" -eq 0 ] || { echo "chaos lane FAILED (rc=$rc)"; exit "$rc"; }
+
+# Large-mesh lane (ISSUE 15): a bounded np=128 simulated cluster —
+# the REAL journaled server + elastic driver over a shaped wire
+# (horovod_tpu/sim/, docs/sim_cluster.md) — completes churn epochs
+# including a coordinated abort, with the lock-dependency tracker armed
+# and ZERO inversion cycles across the batched server/store/journal
+# lock nests.  Deterministic: fixed HOROVOD_SIM_SEED, tight timeouts.
+echo "large-mesh lane: np=128 simulated churn under HOROVOD_LOCK_DEBUG=1"
+rc=0
+JAX_PLATFORMS=cpu HOROVOD_LOCK_DEBUG=1 HOROVOD_SIM_SEED=0 \
+python - > ci/chaos.largemesh.log 2>&1 <<'EOF' || rc=$?
+from horovod_tpu.common import lockdep
+from horovod_tpu.sim.cluster import COORDINATED_ABORT, SimCluster
+
+rec = SimCluster(128, slots_per_host=8, seed=0, lease_timeout=1.2,
+                 renew_period=0.25).run(events=4)
+assert rec["final_epoch"] == 4, rec
+assert rec["events"][-1]["kind"] == COORDINATED_ABORT, rec
+assert rec["attribution"]["coverage"] >= 0.90, rec["attribution"]
+cycles = lockdep.find_cycles()
+assert not cycles, f"lock inversion cycles: {cycles}"
+print(f"np=128 churn: {rec['final_epoch']} epochs, "
+      f"abort {rec['coordinated_abort_ms']:.0f}ms, "
+      f"coverage {rec['attribution']['coverage']:.2%}, 0 lock cycles")
+EOF
+cat ci/chaos.largemesh.log
+[ "$rc" -eq 0 ] || { echo "large-mesh lane FAILED (rc=$rc)"; exit "$rc"; }
 echo "chaos lane PASSED"

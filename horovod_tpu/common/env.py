@@ -57,6 +57,41 @@ HOROVOD_RENDEZVOUS_EXTERNAL = "HOROVOD_RENDEZVOUS_EXTERNAL"
 # elastic driver declares a worker dead and advances the epoch; store
 # outages pause the clock — partitioned/restarting is not dead.
 HOROVOD_LEASE_TIMEOUT_SECS = "HOROVOD_LEASE_TIMEOUT_SECS"
+# -- scale-out control plane (docs/control_plane.md "Batched
+#    transactions") --
+# Batched rendezvous transactions ("1"/"0", default on): clients coalesce
+# a tick's PUT/GET/DELETE/KEYS ops into one signed POST /batch the server
+# applies under ONE store-lock acquisition and journals as ONE atomic
+# record group.  The client degrades to per-op requests against a server
+# that 404s the endpoint, so mixed-version jobs stay correct (just slow).
+HOROVOD_RENDEZVOUS_BATCH = "HOROVOD_RENDEZVOUS_BATCH"
+# Max ops per batch request; larger batches are split client-side.  Caps
+# the store-lock hold time and the atomic journal frame size — one giant
+# batch would serialize every other rendezvous request behind it.
+HOROVOD_RENDEZVOUS_BATCH_MAX_OPS = "HOROVOD_RENDEZVOUS_BATCH_MAX_OPS"
+# Host-level fan-in ("1"/"0"/"auto", default auto = on when local_size >
+# 1 and batching is on): colocated ranks spool their lease renewals +
+# metrics snapshots to the host's aggregator (lowest local rank), which
+# merges them into one batch — control traffic scales with hosts, not
+# ranks.  Ranks fall back to direct per-rank pushes whenever the
+# aggregator's heartbeat goes stale (elastic/fanin.py).
+HOROVOD_FANIN = "HOROVOD_FANIN"
+# Base directory for the fan-in spool (per-host, must be shared by the
+# host's ranks and is probed writable); default /dev/shm.
+HOROVOD_FANIN_DIR = "HOROVOD_FANIN_DIR"
+# -- simulated-cluster harness (horovod_tpu/sim/; docs/sim_cluster.md) --
+# Shaped-wire injection for sim runs: deterministic per-link base latency
+# (ms), uniform jitter bound (ms), and bandwidth (MB/s) applied around
+# every rendezvous client round-trip.  Latency/jitter/bandwidth model the
+# wire the 1-box harness doesn't have; 0 latency + 0 jitter + 0 bandwidth
+# disables shaping.
+HOROVOD_SIM_LATENCY_MS = "HOROVOD_SIM_LATENCY_MS"
+HOROVOD_SIM_JITTER_MS = "HOROVOD_SIM_JITTER_MS"
+HOROVOD_SIM_BANDWIDTH_MBS = "HOROVOD_SIM_BANDWIDTH_MBS"
+# Seed for the per-link shaping RNGs: the same seed reproduces the same
+# per-link delay parameters and jitter sequence, so sim artifacts are
+# deterministic in everything but raw wall-clock.
+HOROVOD_SIM_SEED = "HOROVOD_SIM_SEED"
 
 # -- elastic membership --
 # Monotonic membership epoch, stamped by the elastic driver into every
@@ -298,6 +333,18 @@ DEFAULT_RENDEZVOUS_SNAPSHOT_EVERY = 512
 # three in a row with a reachable store means the pusher thread (and so
 # almost certainly the worker) is gone.
 DEFAULT_LEASE_TIMEOUT_SECS = 15.0
+# 512 ops per batch: an np=512 slot-table republish fits in one or two
+# frames while the store-lock hold per batch stays sub-ms (ops are small
+# JSON values); matches the snapshot cadence so one batch can't skip a
+# compaction check by more than one interval.
+DEFAULT_RENDEZVOUS_BATCH_MAX_OPS = 512
+# Shaping defaults model a quiet intra-DC hop: 0.2 ms base one-way-ish
+# latency + up to 0.05 ms jitter per round-trip, 1 GB/s of bandwidth —
+# enough to make per-op vs batched round-trip counts visible without
+# making np=512 sim runs take minutes.
+DEFAULT_SIM_LATENCY_MS = 0.2
+DEFAULT_SIM_JITTER_MS = 0.05
+DEFAULT_SIM_BANDWIDTH_MBS = 1000.0
 
 
 def get_int(name: str, default: int) -> int:

@@ -134,6 +134,29 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "rendezvous_store_lock_wait_seconds": (
         "histogram", "time a server handler thread waited to acquire the "
                      "store lock (contention term of request latency)"),
+    # -- batched transactions (POST /batch) --
+    "rendezvous_batch_ops_total": (
+        "counter", "KV sub-operations carried inside batched /batch "
+                   "transactions (client side; compare against "
+                   "rendezvous_store_ops_total to see the coalescing win)"),
+    "rendezvous_batch_fallbacks_total": (
+        "counter", "batched requests degraded to per-op calls because the "
+                   "server 404/501'd /batch (old protocol; sticky per "
+                   "client)"),
+    "rendezvous_batch_size": (
+        "histogram", "sub-ops per /batch transaction, server side "
+                     "(bucket bounds top out at 64 — larger batches land "
+                     "in +Inf; use sum/count for the mean)"),
+    # -- simulated cluster (horovod_tpu/sim/) --
+    "sim_identities": (
+        "gauge", "simulated worker identities currently renewing leases "
+                 "(sim harness only)"),
+    "sim_churn_events_total": (
+        "counter", "churn events the simulated cluster injected, labeled "
+                   "kind=lease_expiry|reset_request|worker_exit"),
+    "sim_wire_delay_seconds_total": (
+        "counter", "artificial shaped-wire delay the sim injected across "
+                   "all links (latency + bandwidth + jitter terms)"),
     "journal_append_seconds": (
         "histogram", "durable-store journal append, frame write through "
                      "fsync (the per-mutation durability tax)"),

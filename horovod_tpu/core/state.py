@@ -287,15 +287,21 @@ class HorovodGlobalState:
         ``GET /metrics`` can serve a cross-rank aggregate of a LIVE job,
         and renew this identity's liveness lease on the same cadence
         (``PUT /lease/<identity>`` — the elastic driver's dead-vs-
-        partitioned signal, docs/control_plane.md).  One small PUT pair
-        per period; 0 disables."""
+        partitioned signal, docs/control_plane.md).  The snapshot+lease
+        pair rides one batched transaction; with host fan-in enabled
+        (``elastic/fanin.py``) colocated ranks hand their pair to the
+        host aggregator instead, so the store sees one request per HOST
+        per period.  0 disables."""
         period = env_mod.get_float(env_mod.HOROVOD_METRICS_PUSH_SECS,
                                    env_mod.DEFAULT_METRICS_PUSH_SECS)
         if period <= 0 or not metrics.ENABLED:
             return
         import json as json_mod
 
+        from ..elastic import fanin as fanin_mod
         from ..transport.store import LEASE_SCOPE
+
+        fanin = fanin_mod.maybe_create(store, period)
 
         rank = self.topo.rank
         done = self.shutdown_complete
@@ -323,10 +329,15 @@ class HorovodGlobalState:
             lease = json_mod.dumps({
                 "rank": rank, "epoch": env_mod.get_epoch(),
                 "renewals": renewals[0]}).encode()
+            ops = [("set", metrics.METRICS_SCOPE, f"rank-{rank}",
+                    json_mod.dumps(snap).encode()),
+                   ("set", LEASE_SCOPE, identity, lease)]
             try:
-                store.set(metrics.METRICS_SCOPE, f"rank-{rank}",
-                          json_mod.dumps(snap).encode())
-                store.set(LEASE_SCOPE, identity, lease)
+                # Fan-in first: True means the ops were delivered (or
+                # spooled under a live host aggregator); False means no
+                # aggregator is alive — push directly, same as before.
+                if fanin is None or not fanin.submit(ops):
+                    store.batch(ops)
             except Exception as e:  # noqa: BLE001 — a scrape/lease gap
                 # must never hurt the job; the store may be restarting.
                 now = time.monotonic()

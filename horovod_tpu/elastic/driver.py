@@ -198,21 +198,29 @@ class ElasticDriver:
                         "cross_rank": -1, "size": 0, "local_size": 0,
                         "cross_size": 0, "epoch": self.epoch,
                     }
-            for identity, slot in table.items():
-                self.rendezvous.set("rank_and_size", identity,
-                                    json.dumps(slot).encode())
-            # Durable driver state: a restarted driver re-adopts this
-            # epoch (recover_from_store) instead of resetting to 0 and
-            # respawning the world.
-            self.rendezvous.set(DRIVER_SCOPE, "epoch",
-                                str(self.epoch).encode())
+            # One batched transaction: the whole slot table plus the
+            # durable epoch land atomically (a driver crash mid-publish
+            # can no longer leave a half-written table for
+            # recover_from_store to adopt).  The epoch record rides the
+            # same group so a restarted driver re-adopts this epoch
+            # instead of resetting to 0 and respawning the world.
+            publish_ops = [
+                ("set", "rank_and_size", identity,
+                 json.dumps(slot).encode())
+                for identity, slot in table.items()]
+            publish_ops.append(("set", DRIVER_SCOPE, "epoch",
+                                str(self.epoch).encode()))
+            self.rendezvous.batch(publish_ops)
 
             # Spawn processes for identities that have none yet.  A
             # driver-spawned worker is born at this epoch, so it is
             # implicitly acked — without this, `_renotify_unacked` pings
             # every worker forever after a scale-up (workers spawned fresh
             # never pass through `refresh_topology_from_rendezvous`, the
-            # only other place the ack is written).
+            # only other place the ack is written).  The ack writes are
+            # collected and batched after the spawn loop: the first read
+            # of them is a LATER tick's renotify scan.
+            ack_ops = []
             for s in new_slots:
                 identity = f"{s.hostname}:{s.local_rank}"
                 if identity not in self._known_identities:
@@ -226,9 +234,11 @@ class ElasticDriver:
                             "driver", "DRV_SPAWN", t_spawn,
                             identity=identity, epoch=self.epoch)
                     self._exited_identities.discard(identity)
-                    self.rendezvous.set("epoch_ack", identity,
-                                        str(self.epoch).encode())
+                    ack_ops.append(("set", "epoch_ack", identity,
+                                    str(self.epoch).encode()))
                 self._known_identities[identity] = s
+            if ack_ops:
+                self.rendezvous.batch(ack_ops)
             current = {f"{s.hostname}:{s.local_rank}" for s in new_slots}
             self._removed_identities = {
                 i for i in self._known_identities if i not in current}
@@ -243,10 +253,12 @@ class ElasticDriver:
             # instead of waiting to hit a dead socket.
             identities = {f"{s.hostname}:{s.local_rank}" for s in self._slots}
             identities.update(self._removed_identities)
+        ordered = sorted(identities)
+        raws = self.rendezvous.batch(
+            [("get", WORKERS_SCOPE, identity) for identity in ordered])
         addresses = []
         missing = []
-        for identity in sorted(identities):
-            raw = self.rendezvous.get(WORKERS_SCOPE, identity)
+        for identity, raw in zip(ordered, raws):
             if raw:
                 addresses.append(raw.decode())
             else:
@@ -291,9 +303,10 @@ class ElasticDriver:
         # membership judgment (no lease expiry, no epoch advance)
         # until it answers again, then re-grace the lease clocks.
         try:
-            self._renotify_unacked()
-            reset_reasons = self._pending_reset_requests()
-            expired = self._scan_leases()
+            fetched = self._tick_store_reads()
+            self._renotify_unacked(fetched.get("epoch_ack"))
+            reset_reasons = self._pending_reset_requests(fetched["reset"])
+            expired = self._scan_leases(fetched["lease"])
             self._store_recovered()
             self._push_driver_metrics()
         except self._STORE_ERRORS as e:
@@ -372,6 +385,43 @@ class ElasticDriver:
             timeline_mod.control_instant(
                 "driver", "EPOCH_TRANSITION", epoch=self.epoch, cause=cause)
 
+    def _tick_store_reads(self) -> Dict[str, Optional[Dict[str, object]]]:
+        """Coalesce this tick's store reads into ONE batched round-trip.
+
+        The pre-batching tick issued ``keys + 2–3 ops per identity``
+        sequentially — at np=64 that is ~81% of a churn event's latency
+        (``benchmarks/results/controller_churn_np64.json``, r14).  A
+        single ``/batch`` carries the renotify ack reads, the
+        reset-request reads, and the lease reads; a get of an absent key
+        returns None, which each consumer already treats as "not
+        present", so the old keys-then-intersect dance is unnecessary.
+        Raises the store error on outage, like every other tick op."""
+        with self._lock:
+            slot_ids = sorted({f"{s.hostname}:{s.local_rank}"
+                               for s in self._slots})
+            ack_ids = None
+            if self._await_ack is not None and self.epoch != 0:
+                ids = set(slot_ids) | self._removed_identities
+                ids -= self._exited_identities
+                ack_ids = sorted(ids)
+        ops: List[tuple] = []
+        if ack_ids is not None:
+            ops.extend(("get", "epoch_ack", i) for i in ack_ids)
+        ops.extend(("get", rendezvous_client.RESET_REQUEST_SCOPE, i)
+                   for i in slot_ids)
+        ops.extend(("get", LEASE_SCOPE, i) for i in slot_ids)
+        results = self.rendezvous.batch(ops)
+        idx = 0
+        out: Dict[str, Optional[Dict[str, object]]] = {"epoch_ack": None}
+        if ack_ids is not None:
+            out["epoch_ack"] = dict(
+                zip(ack_ids, results[idx:idx + len(ack_ids)]))
+            idx += len(ack_ids)
+        out["reset"] = dict(zip(slot_ids, results[idx:idx + len(slot_ids)]))
+        idx += len(slot_ids)
+        out["lease"] = dict(zip(slot_ids, results[idx:]))
+        return out
+
     def _push_driver_metrics(self) -> None:
         """External-server deployments only: the driver's gauges and
         counters live in the launcher process, which the (remote) server's
@@ -388,21 +438,27 @@ class ElasticDriver:
         self.rendezvous.set(metrics.METRICS_SCOPE, "driver",
                             json.dumps(snap).encode())
 
-    def _pending_reset_requests(self) -> List[str]:
+    def _pending_reset_requests(
+            self, raws: Optional[Dict[str, object]] = None) -> List[str]:
         """Worker-posted epoch-reset requests for the CURRENT epoch.
 
         The integrity plane's recovery trigger: a corruption abort leaves
         every worker alive-but-rolled-back, waiting for an epoch that no
         exit or host change would ever produce.  A request stamped with an
         OLDER epoch was already answered by a later bump and is ignored —
-        the same staleness rule the abort frames use."""
+        the same staleness rule the abort frames use.  ``raws`` is the
+        tick's batched prefetch (identity -> value); None falls back to
+        per-identity reads."""
         reasons = []
-        with self._lock:
-            identities = {f"{s.hostname}:{s.local_rank}"
-                          for s in self._slots}
-        for identity in sorted(identities):
-            raw = self.rendezvous.get(
-                rendezvous_client.RESET_REQUEST_SCOPE, identity)
+        if raws is None:
+            with self._lock:
+                identities = {f"{s.hostname}:{s.local_rank}"
+                              for s in self._slots}
+            raws = {identity: self.rendezvous.get(
+                        rendezvous_client.RESET_REQUEST_SCOPE, identity)
+                    for identity in identities}
+        for identity in sorted(raws):
+            raw = raws[identity]
             if raw is None:
                 continue
             try:
@@ -416,22 +472,31 @@ class ElasticDriver:
 
     # -- lease liveness / store outage (docs/control_plane.md) ---------
 
-    def _scan_leases(self) -> Set[str]:
+    def _scan_leases(
+            self, raws: Optional[Dict[str, object]] = None) -> Set[str]:
         """Identities whose lease EXPIRED while the store was reachable.
 
         Identities that never posted a lease are exempt (metrics pushes
         disabled, or a pre-survivability worker) — exit-watching still
         covers those.  Raises the store error on outage: the caller's
-        partitioned mode is the only place that decides what that means."""
+        partitioned mode is the only place that decides what that means.
+        ``raws`` is the tick's batched prefetch (every slot identity,
+        None where no lease exists — same exemption); None falls back to
+        the keys-then-get scan."""
         now = time.monotonic()
-        with self._lock:
-            identities = {f"{s.hostname}:{s.local_rank}"
-                          for s in self._slots}
-        leased = set(self.rendezvous.keys(LEASE_SCOPE))
+        if raws is None:
+            with self._lock:
+                identities = {f"{s.hostname}:{s.local_rank}"
+                              for s in self._slots}
+            leased = set(self.rendezvous.keys(LEASE_SCOPE))
+            raws = {identity: self.rendezvous.get(LEASE_SCOPE, identity)
+                    for identity in identities & leased}
+        else:
+            identities = set(raws)
         expired: Set[str] = set()
         min_ttl: Optional[float] = None
-        for identity in sorted(identities & leased):
-            raw = self.rendezvous.get(LEASE_SCOPE, identity)
+        for identity in sorted(raws):
+            raw = raws[identity]
             if raw is None:
                 continue
             seen = self._lease_seen.get(identity)
@@ -492,10 +557,16 @@ class ElasticDriver:
             self.epoch = int(raw.decode())
             now = time.monotonic()
             adopted = []
-            for identity in self.rendezvous.keys(LEASE_SCOPE):
-                lease = self.rendezvous.get(LEASE_SCOPE, identity)
-                slot_raw = self.rendezvous.get(
-                    rendezvous_client.RANK_AND_SIZE_SCOPE, identity)
+            leased = self.rendezvous.keys(LEASE_SCOPE)
+            fetch_ops: List[tuple] = []
+            for identity in leased:
+                fetch_ops.append(("get", LEASE_SCOPE, identity))
+                fetch_ops.append(("get",
+                                  rendezvous_client.RANK_AND_SIZE_SCOPE,
+                                  identity))
+            fetched = self.rendezvous.batch(fetch_ops)
+            for i, identity in enumerate(leased):
+                lease, slot_raw = fetched[2 * i], fetched[2 * i + 1]
                 if lease is None or slot_raw is None:
                     continue
                 try:
@@ -527,24 +598,30 @@ class ElasticDriver:
 
     # ------------------------------------------------------------------
 
-    def _renotify_unacked(self) -> None:
+    def _renotify_unacked(
+            self, acks: Optional[Dict[str, object]] = None) -> None:
         """Notification is racy against worker startup (a worker may
         register its endpoint just after a change fired).  Until every
         current identity acks the epoch, keep pinging the UNACKED ones each
-        tick (pinging acked workers too would feed them stale interrupts)."""
+        tick (pinging acked workers too would feed them stale interrupts).
+        ``acks`` is the tick's batched prefetch (identity -> raw ack);
+        None falls back to per-identity reads."""
         if self._await_ack is None or self.epoch == 0:
             return
-        with self._lock:
-            identities = {f"{s.hostname}:{s.local_rank}" for s in self._slots}
-            # Removed identities need the ping too (it is what makes their
-            # worker see rank −1 and exit promptly); they ack before
-            # exiting.  Identities whose process exited have nobody
-            # listening.
-            identities.update(self._removed_identities)
-            identities -= self._exited_identities
+        if acks is None:
+            with self._lock:
+                identities = {f"{s.hostname}:{s.local_rank}"
+                              for s in self._slots}
+                # Removed identities need the ping too (it is what makes
+                # their worker see rank −1 and exit promptly); they ack
+                # before exiting.  Identities whose process exited have
+                # nobody listening.
+                identities.update(self._removed_identities)
+                identities -= self._exited_identities
+            acks = {identity: self.rendezvous.get("epoch_ack", identity)
+                    for identity in identities}
         unacked = set()
-        for identity in identities:
-            raw = self.rendezvous.get("epoch_ack", identity)
+        for identity, raw in acks.items():
             if raw is None or int(raw.decode()) < self.epoch:
                 unacked.add(identity)
         if not unacked:

@@ -42,13 +42,23 @@ from ..common import env as env_mod
 from ..common import faults
 from ..core import metrics as metrics_mod
 from ..core import timeline as timeline_mod
-from ..transport.store import KEYS_PSEUDO_SCOPE, DurableMemoryStore
+from ..transport.store import (
+    BATCH_PATH,
+    KEYS_PSEUDO_SCOPE,
+    DurableMemoryStore,
+    decode_batch_ops,
+    encode_batch_results,
+)
 
 RANK_AND_SIZE_SCOPE = "rank_and_size"
 
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Status+headers and the body leave as two small sends; on a
+    # keep-alive connection Nagle holds the second until the client's
+    # delayed ACK (~40 ms/response — dwarfs the batch it carries).
+    disable_nagle_algorithm = True
 
     # quiet by default
     def log_message(self, fmt, *args):  # noqa: D102
@@ -229,6 +239,42 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             self._obs_end(t0, "delete", scope)
 
+    def do_POST(self):
+        """``POST /batch``: one signed, ordered multi-op transaction
+        (docs/control_plane.md "Batched transactions").  The op list is
+        applied under ONE store-lock acquisition and journaled as ONE
+        atomic record group; the response carries per-op results.  With
+        batching disabled server-side (HOROVOD_RENDEZVOUS_BATCH=0) the
+        endpoint 404s, which is also what a pre-batch server does — the
+        client's per-op fallback covers both."""
+        t0 = self._obs_begin()
+        try:
+            # Drain the body before any error reply: HTTP/1.1 keep-alive
+            # would otherwise read it as the next request line.
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if self.path.partition("?")[0] != BATCH_PATH \
+                    or not self.server.batch_enabled:
+                self.send_error(404, "no such endpoint")
+                return
+            if not self._authorized(body):
+                return
+            try:
+                ops = decode_batch_ops(body)
+            except (ValueError, KeyError, TypeError):
+                self.send_error(400, "malformed batch body")
+                return
+            results = self.server.store_batch(ops)
+            if metrics_mod.ENABLED:
+                metrics_mod.observe("rendezvous_batch_size",
+                                    float(len(ops)))
+                for op in ops:
+                    metrics_mod.inc("rendezvous_scope_ops_total",
+                                    scope=op[1], op=op[0])
+            self._reply(encode_batch_results(results), "application/json")
+        finally:
+            self._obs_end(t0, "batch", "-")
+
 
 class _KVServer(ThreadingHTTPServer):
     daemon_threads = True
@@ -249,6 +295,11 @@ class _KVServer(ThreadingHTTPServer):
         self._delete_hook = delete_hook
         self.job_secret = job_secret
         self.timeline = timeline
+        # Server side of the batch knob: "0" 404s POST /batch, turning
+        # this process into an old-protocol server (the client-fallback
+        # test arm and the A/B's sequential control both use it).
+        self.batch_enabled = env_mod.get_bool(
+            env_mod.HOROVOD_RENDEZVOUS_BATCH, True)
         # In-flight request count; its lock is a leaf (gauge recorded
         # after release).
         self._inflight = 0
@@ -279,6 +330,17 @@ class _KVServer(ThreadingHTTPServer):
 
     def store_keys(self, scope: str) -> List[str]:
         return self._store.keys(scope)
+
+    def store_batch(self, ops: List[tuple]) -> List[object]:
+        # One lock acquisition + one atomic journal group inside; delete
+        # hooks fire after the transaction, outside the store lock, and
+        # only for deletes that found their key (pop semantics).
+        results = self._store.batch(ops)
+        if self._delete_hook is not None:
+            for op, res in zip(ops, results):
+                if op[0] == "delete" and res:
+                    self._delete_hook(op[1], op[2])
+        return results
 
 
 class RendezvousServer:
@@ -333,15 +395,16 @@ class RendezvousServer:
     def publish_slots(self, slots: List[dict]) -> None:
         """Publish the slot table (rank/local/cross per slot) for elastic
         re-rendezvous — reference publishes the host-alloc plan the same way
-        (``http_server.py`` init / ``gloo_context.cc:154-189`` reads it)."""
+        (``http_server.py`` init / ``gloo_context.cc:154-189`` reads it).
+        One batched transaction: the whole table lands atomically."""
         assert self._server is not None
         import json
 
-        for slot in slots:
-            self._server.store_set(
-                RANK_AND_SIZE_SCOPE,
-                f"{slot['hostname']}:{slot['local_rank']}",
-                json.dumps(slot).encode())
+        self.batch([
+            ("set", RANK_AND_SIZE_SCOPE,
+             f"{slot['hostname']}:{slot['local_rank']}",
+             json.dumps(slot).encode())
+            for slot in slots])
 
     def set(self, scope: str, key: str, value: bytes) -> None:
         assert self._server is not None
@@ -354,6 +417,10 @@ class RendezvousServer:
     def keys(self, scope: str) -> List[str]:
         assert self._server is not None
         return self._server.store_keys(scope)
+
+    def batch(self, ops: List[tuple]) -> List[object]:
+        assert self._server is not None
+        return self._server.store_batch(ops)
 
     def stop(self) -> None:
         if self._server is not None:
@@ -374,22 +441,26 @@ class ExternalRendezvous:
     mode keys off.  ``stop()`` is a no-op: the server's lifetime belongs
     to its supervisor, which is the point — it outlives the launcher."""
 
-    def __init__(self, addr: str, port: int):
+    def __init__(self, addr: str, port: int, client=None):
         from ..transport.store import HTTPStoreClient
 
         self.addr = addr
         self._port = int(port)
-        self._client = HTTPStoreClient(addr, self._port)
+        # ``client`` lets the sim harness (horovod_tpu/sim/) substitute a
+        # shaped-wire wrapper; production callers leave it None.
+        self._client = client if client is not None \
+            else HTTPStoreClient(addr, self._port)
 
     @property
     def port(self) -> int:
         return self._port
 
     def publish_slots(self, slots: List[dict]) -> None:
-        for slot in slots:
-            self.set(RANK_AND_SIZE_SCOPE,
-                     f"{slot['hostname']}:{slot['local_rank']}",
-                     json.dumps(slot).encode())
+        self.batch([
+            ("set", RANK_AND_SIZE_SCOPE,
+             f"{slot['hostname']}:{slot['local_rank']}",
+             json.dumps(slot).encode())
+            for slot in slots])
 
     def set(self, scope: str, key: str, value: bytes) -> None:
         self._client.set(scope, key, value)
@@ -399,6 +470,9 @@ class ExternalRendezvous:
 
     def keys(self, scope: str) -> List[str]:
         return self._client.keys(scope)
+
+    def batch(self, ops: List[tuple]) -> List[object]:
+        return self._client.batch(ops)
 
     def stop(self) -> None:
         pass

@@ -1,0 +1,5 @@
+"""Simulated-cluster harness: real control plane, shaped wire, fake
+workers (docs/sim_cluster.md)."""
+
+from .cluster import SimCluster, SimWorker  # noqa: F401
+from .wire import ShapedStore, ShapedWire  # noqa: F401

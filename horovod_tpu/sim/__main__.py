@@ -1,0 +1,56 @@
+"""CLI for the simulated-cluster harness::
+
+    python -m horovod_tpu.sim --np 512 --events 6 \\
+        --out benchmarks/results/sim_churn_np512.json
+
+Runs churn epochs (the last always a coordinated abort) through the
+REAL journaled rendezvous server + elastic driver over a shaped wire and
+writes one artifact record per ``--np``; see docs/sim_cluster.md.
+Determinism: fix ``--seed`` (or ``HOROVOD_SIM_SEED``) and the schedule +
+wire digest reproduce exactly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .cluster import SimCluster
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="python -m horovod_tpu.sim")
+    p.add_argument("--np", type=int, nargs="+", default=[128],
+                   help="world sizes to simulate (one record each)")
+    p.add_argument("--slots-per-host", type=int, default=8)
+    p.add_argument("--events", type=int, default=6,
+                   help="churn events per run (last = coordinated abort)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="override HOROVOD_SIM_SEED")
+    p.add_argument("--lease-timeout", type=float, default=1.5)
+    p.add_argument("--renew-period", type=float, default=0.25)
+    p.add_argument("--no-trace", action="store_true",
+                   help="skip timeline capture + attribution")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    lines = []
+    for np_ in args.np:
+        cluster = SimCluster(
+            np_, slots_per_host=args.slots_per_host, seed=args.seed,
+            lease_timeout=args.lease_timeout,
+            renew_period=args.renew_period, trace=not args.no_trace)
+        rec = cluster.run(args.events)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        lines.append(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

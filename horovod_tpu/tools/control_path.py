@@ -15,10 +15,17 @@ stack mis-nesting):
   driver (``elastic/driver.py``, cause-tagged) or by
   ``benchmarks/controller_sim.py --churn``.  Each defines an **event
   window**.
-- ``RVC_SET/GET/KEYS/DELETE`` — client-side HTTP round-trips
+- ``RVC_SET/GET/KEYS/DELETE/BATCH`` — client-side HTTP round-trips
   (``transport/store.py``), and ``RV_PUT/GET/…`` — the server-side
   handler spans (``runner/rendezvous.py``, merging unshifted because the
-  server is trace_merge's clock base).
+  server is trace_merge's clock base).  ``RVC_WIRE`` — injected shaped-
+  wire delay from the simulated-cluster harness (``horovod_tpu/sim/``);
+  simulated propagation time is honestly round-trip time.
+- ``RV_BATCH`` — the server applying one batched transaction
+  (``POST /batch``): decode, ONE store-lock acquisition, one journaled
+  record group.  Its own phase (``batch_apply``), because transaction
+  application is server compute, not wire time — lumping it into
+  ``http_roundtrip`` would hide exactly the cost batching moved.
 - ``RV_LOCK_WAIT`` — store-lock contention on the server.
 - ``JR_FSYNC/JR_COMPACT/JR_REPLAY`` — journal durability work
   (``transport/journal.py``).
@@ -27,7 +34,8 @@ stack mis-nesting):
 
 Within each event window the phases are carved into **disjoint**
 intervals in cost order — lock wait and fsync first (they nest inside the
-HTTP round-trips that caused them), then HTTP, respawn, tick wait — so
+batch application / HTTP round-trips that caused them), then batch
+apply, HTTP, respawn, tick wait — so
 the per-phase times sum to the covered fraction of the window and
 ``coverage`` honestly reports how much of the event's wall time the
 instrumentation explains (the PR acceptance floor is 0.90).
@@ -55,8 +63,8 @@ EVENT_SPAN = "CHURN_EVENT"
 #: event window and reduced by everything already attributed, so nested
 #: costs (a lock wait inside an HTTP round-trip) count once, under the
 #: most specific name.
-PHASES = ("store_lock_wait", "journal_fsync", "http_roundtrip",
-          "respawn", "driver_tick_wait")
+PHASES = ("store_lock_wait", "journal_fsync", "batch_apply",
+          "http_roundtrip", "respawn", "driver_tick_wait")
 
 _JOURNAL_SPANS = {"JR_FSYNC", "JR_COMPACT", "JR_REPLAY"}
 
@@ -66,6 +74,8 @@ def _phase_of(name: str) -> Optional[str]:
         return "store_lock_wait"
     if name in _JOURNAL_SPANS:
         return "journal_fsync"
+    if name == "RV_BATCH":
+        return "batch_apply"
     if name.startswith("RVC_") or name.startswith("RV_"):
         return "http_roundtrip"
     if name == "DRV_SPAWN":

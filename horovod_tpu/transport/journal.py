@@ -18,9 +18,18 @@ On-disk layout (one directory per store)::
 Every file is a sequence of frames ``<Q payload_len><I crc32(payload)>``
 followed by the payload.  A journal's first frame is the magic
 ``HVDJRNL1``; each later frame is one op: ``<B op><I key_len>key[value]``
-with op 1 = SET, 2 = DELETE.  A snapshot is magic ``HVDSNAP1``, one SET
-frame per key, and the commit marker ``HVDSNAP-END`` — a snapshot without
-its end marker is an aborted compaction and is ignored by recovery.
+with op 1 = SET, 2 = DELETE — or one **atomic group** (op 3): a batched
+rendezvous transaction journaled as ``<B 3><I count>`` followed by
+``count`` length-prefixed sub-op records (``<I len><op record>``).  A
+snapshot is magic ``HVDSNAP1``, one SET frame per key, and the commit
+marker ``HVDSNAP-END`` — a snapshot without its end marker is an aborted
+compaction and is ignored by recovery.
+
+A group is ONE frame, so the longest-valid-prefix rule makes it atomic
+for free: a torn tail mid-group fails the frame's crc and replays NONE
+of its sub-ops; an intact frame replays ALL of them.  There is no
+begin/commit marker pair to keep consistent — the frame boundary IS the
+transaction boundary.
 
 Crash-consistency invariants:
 
@@ -68,6 +77,14 @@ _OP = struct.Struct("<BI")
 
 OP_SET = 1
 OP_DELETE = 2
+#: Atomic record group (batched rendezvous transaction): the frame
+#: payload is ``<B 3><I count>`` + count × ``<I len><sub-op record>``,
+#: each sub-op an OP_SET/OP_DELETE record.  Replays all-or-nothing
+#: because the group shares one frame (one crc32).
+OP_GROUP = 3
+
+#: Length prefix of each sub-op record inside a group payload.
+_GROUP_LEN = struct.Struct("<I")
 
 JOURNAL_MAGIC = b"HVDJRNL1"
 SNAP_MAGIC = b"HVDSNAP1"
@@ -111,6 +128,38 @@ def decode_op(payload: bytes) -> Tuple[int, str, bytes]:
         raise ValueError("op record shorter than its key length")
     key = payload[_OP.size:key_end].decode("utf-8")
     return op, key, bytes(payload[key_end:])
+
+
+def encode_group(records: List[Tuple[int, str, bytes]]) -> bytes:
+    """One frame payload for an atomic group of (op, key, value) records."""
+    parts = [_OP.pack(OP_GROUP, len(records))]
+    for op, key, value in records:
+        rec = encode_op(op, key, value)
+        parts.append(_GROUP_LEN.pack(len(rec)))
+        parts.append(rec)
+    return b"".join(parts)
+
+
+def decode_group(payload: bytes) -> List[Tuple[int, str, bytes]]:
+    """Inverse of :func:`encode_group`; raises ValueError on any
+    structural mismatch (count vs records, truncated sub-record)."""
+    op, count = _OP.unpack_from(payload)
+    if op != OP_GROUP:
+        raise ValueError(f"not a group record (op={op})")
+    records: List[Tuple[int, str, bytes]] = []
+    off = _OP.size
+    for _ in range(count):
+        if off + _GROUP_LEN.size > len(payload):
+            raise ValueError("group record truncated at a length prefix")
+        (rec_len,) = _GROUP_LEN.unpack_from(payload, off)
+        off += _GROUP_LEN.size
+        if off + rec_len > len(payload):
+            raise ValueError("group sub-record shorter than its length")
+        records.append(decode_op(payload[off:off + rec_len]))
+        off += rec_len
+    if off != len(payload):
+        raise ValueError("trailing bytes after the last group sub-record")
+    return records
 
 
 class StoreJournal:
@@ -252,16 +301,34 @@ class StoreJournal:
                     break  # foreign file: replay nothing, rewrite below
                 valid_len = end
                 continue
-            try:
-                op, key, value = decode_op(payload)
-            except (ValueError, struct.error):
-                break
-            if op == OP_SET:
-                state[key] = value
-            elif op == OP_DELETE:
-                state.pop(key, None)
+            # Dispatch on the op byte BEFORE decode_op: a group frame's
+            # count/length fields are binary, and decode_op would try to
+            # utf-8 them as a key.
+            if payload and payload[0] == OP_GROUP:
+                # Atomic group: the frame's crc already vouched for every
+                # byte, so a structural decode error here is corruption —
+                # stop (applying a partial group would break atomicity).
+                try:
+                    records = decode_group(payload)
+                except (ValueError, struct.error):
+                    break
+                for gop, gkey, gvalue in records:
+                    if gop == OP_SET:
+                        state[gkey] = gvalue
+                    elif gop == OP_DELETE:
+                        state.pop(gkey, None)
+                nops += len(records) - 1  # +1 below, like a plain op
             else:
-                break
+                try:
+                    op, key, value = decode_op(payload)
+                except (ValueError, struct.error):
+                    break
+                if op == OP_SET:
+                    state[key] = value
+                elif op == OP_DELETE:
+                    state.pop(key, None)
+                else:
+                    break
             valid_len = end
             nops += 1
         return state, valid_len, nops
@@ -317,6 +384,21 @@ class StoreJournal:
             self._fh.write(pack_frame(encode_op(OP_DELETE, key)))
             fsync_s = self._sync_locked()
             self._ops_since_snap += 1
+        self._record_append(t0, fsync_s)
+
+    def append_group(self, records: List[Tuple[int, str, bytes]]) -> None:
+        """Append a batched transaction as ONE frame (one write, one
+        fsync): the whole group replays or none of it does.  ``records``
+        are (OP_SET/OP_DELETE, key, value) tuples in apply order."""
+        if not records:
+            return
+        t0 = time.monotonic_ns()
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(pack_frame(encode_group(records)))
+            fsync_s = self._sync_locked()
+            self._ops_since_snap += len(records)
         self._record_append(t0, fsync_s)
 
     def maybe_compact(self, state: Dict[str, bytes]) -> bool:

@@ -11,13 +11,16 @@ reassignment.
 
 from __future__ import annotations
 
+import base64
+import http.client
+import io
 import json
 import threading
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 #: Scope workers renew their liveness lease in (``PUT /lease/<identity>``
 #: on the metrics-push cadence); the elastic driver judges dead-vs-
@@ -29,6 +32,78 @@ LEASE_SCOPE = "lease"
 #: Reserved pseudo-scope for the server's key-enumeration endpoint
 #: (``GET /__keys__/<scope>`` → JSON list); never used as a real scope.
 KEYS_PSEUDO_SCOPE = "__keys__"
+
+#: Endpoint for batched rendezvous transactions (``POST /batch``): one
+#: signed request carrying an ordered op list, applied under one store-
+#: lock acquisition and journaled as one atomic record group
+#: (docs/control_plane.md "Batched transactions").
+BATCH_PATH = "/batch"
+
+#: Overlay marker for a key deleted earlier in the same batch.
+_TOMBSTONE = object()
+
+
+# -- batch wire codec (shared with runner/rendezvous.py's /batch handler;
+#    JSON + base64 values, signed like every KV op) -----------------------
+
+def encode_batch_ops(ops: List[tuple]) -> bytes:
+    """Serialize an ordered op list — ``("set", scope, key, value)`` /
+    ``("get", scope, key)`` / ``("delete", scope, key)`` /
+    ``("keys", scope)`` — into one request body."""
+    out = []
+    for op in ops:
+        kind = op[0]
+        if kind == "set":
+            out.append({"op": "set", "scope": op[1], "key": op[2],
+                        "value": base64.b64encode(op[3]).decode("ascii")})
+        elif kind in ("get", "delete"):
+            out.append({"op": kind, "scope": op[1], "key": op[2]})
+        elif kind == "keys":
+            out.append({"op": "keys", "scope": op[1]})
+        else:
+            raise ValueError(f"unknown batch op {kind!r}")
+    return json.dumps({"ops": out}).encode()
+
+
+def decode_batch_ops(body: bytes) -> List[tuple]:
+    doc = json.loads(body.decode())
+    ops: List[tuple] = []
+    for item in doc["ops"]:
+        kind = item["op"]
+        if kind == "set":
+            ops.append(("set", item["scope"], item["key"],
+                        base64.b64decode(item["value"])))
+        elif kind in ("get", "delete"):
+            ops.append((kind, item["scope"], item["key"]))
+        elif kind == "keys":
+            ops.append(("keys", item["scope"]))
+        else:
+            raise ValueError(f"unknown batch op {kind!r}")
+    return ops
+
+
+def encode_batch_results(results: List[object]) -> bytes:
+    """Per-op results, positionally aligned with the request's op list:
+    set → True, get → bytes or None, delete → existed bool, keys →
+    sorted name list.  bytes ride base64 under a distinct wrapper key so
+    a JSON ``null`` get-result stays distinguishable."""
+    out = []
+    for r in results:
+        if isinstance(r, bytes):
+            out.append({"b64": base64.b64encode(r).decode("ascii")})
+        else:
+            out.append({"v": r})
+    return json.dumps({"results": out}).encode()
+
+
+def decode_batch_results(body: bytes) -> List[object]:
+    out: List[object] = []
+    for item in json.loads(body.decode())["results"]:
+        if "b64" in item:
+            out.append(base64.b64decode(item["b64"]))
+        else:
+            out.append(item["v"])
+    return out
 
 
 class Store:
@@ -42,6 +117,37 @@ class Store:
         raise NotImplementedError
 
     def delete(self, scope: str, key: str) -> None:
+        raise NotImplementedError
+
+    def batch(self, ops: List[tuple]) -> List[object]:
+        """Ordered multi-op transaction; results align positionally with
+        ``ops`` (set → True, get → bytes|None, delete → existed bool,
+        keys → name list).
+
+        Base implementation: a per-op loop — the compatibility path a
+        batching client degrades to against an old-protocol server (no
+        atomicity; the delete-existed answer is a get+delete pair).
+        :class:`MemoryStore` applies the whole list under ONE lock
+        acquisition; :class:`HTTPStoreClient` ships ONE ``POST /batch``."""
+        results: List[object] = []
+        for op in ops:
+            kind = op[0]
+            if kind == "set":
+                self.set(op[1], op[2], op[3])
+                results.append(True)
+            elif kind == "get":
+                results.append(self.get(op[1], op[2]))
+            elif kind == "delete":
+                existed = self.get(op[1], op[2]) is not None
+                self.delete(op[1], op[2])
+                results.append(existed)
+            elif kind == "keys":
+                results.append(self.keys(op[1]))
+            else:
+                raise ValueError(f"unknown batch op {kind!r}")
+        return results
+
+    def keys(self, scope: str) -> List[str]:
         raise NotImplementedError
 
     def wait(self, scope: str, keys: List[str], timeout: float = 60.0) -> Dict[str, bytes]:
@@ -154,6 +260,84 @@ class MemoryStore(Store):
         finally:
             self._cv.release()
 
+    def batch(self, ops: List[tuple]) -> List[object]:
+        """The whole ordered op list under ONE lock acquisition.
+
+        Ordered read-your-writes semantics: a get/keys op observes the
+        batch's earlier mutations (staged in an overlay) but nothing is
+        applied — or journaled — until every op has been evaluated, so
+        the journal group matches exactly what the memory apply does.
+        WAL ordering holds batch-wide: the group record is (fsync'd and)
+        written before the first byte of the overlay lands in ``_data``."""
+        from .journal import OP_DELETE, OP_SET
+
+        self._acquire()
+        try:
+            data = self._data
+            overlay: Dict[str, object] = {}
+            mutations: List[Tuple[int, str, bytes]] = []
+            results: List[object] = []
+
+            def current(flat: str):
+                if flat in overlay:
+                    v = overlay[flat]
+                    return None if v is _TOMBSTONE else v
+                return data.get(flat)
+
+            any_set = False
+            for op in ops:
+                kind = op[0]
+                if kind == "set":
+                    _, scope, key, value = op
+                    flat = f"{scope}/{key}"
+                    overlay[flat] = value
+                    mutations.append((OP_SET, flat, value))
+                    results.append(True)
+                    any_set = True
+                elif kind == "get":
+                    results.append(current(f"{op[1]}/{op[2]}"))
+                elif kind == "delete":
+                    flat = f"{op[1]}/{op[2]}"
+                    existed = current(flat) is not None
+                    if existed:  # no journal record for a no-op delete
+                        overlay[flat] = _TOMBSTONE
+                        mutations.append((OP_DELETE, flat, b""))
+                    results.append(existed)
+                elif kind == "keys":
+                    prefix = f"{op[1]}/"
+                    names = {k[len(prefix):] for k in data
+                             if k.startswith(prefix)}
+                    for flat, v in overlay.items():
+                        if flat.startswith(prefix):
+                            if v is _TOMBSTONE:
+                                names.discard(flat[len(prefix):])
+                            else:
+                                names.add(flat[len(prefix):])
+                    results.append(sorted(names))
+                else:
+                    raise ValueError(f"unknown batch op {kind!r}")
+            self._journal_group(mutations)
+            for flat, v in overlay.items():
+                if v is _TOMBSTONE:
+                    data.pop(flat, None)
+                else:
+                    data[flat] = v
+            if any_set:
+                self._cv.notify_all()
+            self._after_batch_locked()
+            return results
+        finally:
+            self._cv.release()
+
+    def _journal_group(self, mutations: List[Tuple[int, str, bytes]]
+                       ) -> None:
+        """Durability hook, called (with the store lock held) before a
+        batch's mutations are applied; plain MemoryStore has no journal."""
+
+    def _after_batch_locked(self) -> None:
+        """Post-apply hook (store lock held): DurableMemoryStore checks
+        the compaction budget here."""
+
 
 class DurableMemoryStore(MemoryStore):
     """MemoryStore + write-ahead journal (``transport/journal.py``).
@@ -222,6 +406,14 @@ class DurableMemoryStore(MemoryStore):
         finally:
             self._cv.release()
 
+    def _journal_group(self, mutations) -> None:
+        if self._journal is not None:
+            self._journal.append_group(mutations)
+
+    def _after_batch_locked(self) -> None:
+        if self._journal is not None:
+            self._journal.maybe_compact(self._data)
+
     def close(self) -> None:
         if self._journal is not None:
             self._journal.close()
@@ -237,11 +429,27 @@ class HTTPStoreClient(Store):
 
     def __init__(self, addr: str, port: int, timeout: float = 30.0):
         self._base = f"http://{addr}:{port}"
+        self._addr = addr
+        self._port = port
         self._timeout = timeout
+        # Keep-alive connections for the hot batch path, one per thread
+        # (the driver's discovery thread and the main thread share one
+        # client; http.client connections are not thread-safe).
+        self._conn_local = threading.local()
         # Per-job HMAC key (common/secret.py); None = unsigned dev mode.
+        from ..common import env as env_mod
         from ..common import secret as secret_mod
 
         self._secret = secret_mod.job_secret()
+        # Batched transactions (POST /batch): knob-gated, capped, and
+        # sticky-degraded — the first 404/501 from an old-protocol server
+        # flips this client to per-op mode for its lifetime.
+        self._batch_enabled = env_mod.get_bool(
+            env_mod.HOROVOD_RENDEZVOUS_BATCH, True)
+        self._batch_max_ops = max(1, env_mod.get_int(
+            env_mod.HOROVOD_RENDEZVOUS_BATCH_MAX_OPS,
+            env_mod.DEFAULT_RENDEZVOUS_BATCH_MAX_OPS))
+        self._batch_unsupported = False
 
     def _url(self, scope: str, key: str) -> str:
         return f"{self._base}/{urllib.parse.quote(scope)}/{urllib.parse.quote(key)}"
@@ -258,6 +466,53 @@ class HTTPStoreClient(Store):
                            secret_mod.sign(self._secret, method, path,
                                            data or b""))
         return req
+
+    def _keepalive_post(self, path: str, body: bytes) -> bytes:
+        """Signed POST over a persistent per-thread HTTP/1.1 connection.
+
+        The per-tick coalesced batch makes the control plane's cost one
+        round-trip per tick — but with one-shot ``urlopen`` most of that
+        round-trip is TCP connect + the server's per-connection thread
+        spawn, not the request itself.  Reusing the connection keeps
+        ``http_roundtrip`` honest: it measures the wire, not the socket
+        churn.  Same retry/answer contract as ``_open_with_retry``: a
+        non-200 status is a protocol answer (raised as ``HTTPError`` so
+        the 404/501 fallback logic upstream is unchanged), while a stale
+        or reset connection — the server restarted, or an idle keep-alive
+        timed out — reconnects and replays (idempotent signed KV ops)."""
+        headers = {}
+        if self._secret is not None:
+            from ..common import secret as secret_mod
+
+            headers[secret_mod.SIG_HEADER] = secret_mod.sign(
+                self._secret, "POST", path, body)
+        last: Optional[Exception] = None
+        for attempt in range(4):
+            conn = getattr(self._conn_local, "conn", None)
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    self._addr, self._port, timeout=self._timeout)
+                self._conn_local.conn = conn
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()  # drain fully: keeps the conn reusable
+                if resp.will_close:
+                    conn.close()
+                    self._conn_local.conn = None
+                if resp.status != 200:
+                    raise urllib.error.HTTPError(
+                        self._base + path, resp.status, resp.reason,
+                        resp.headers, io.BytesIO(data))
+                return data
+            except urllib.error.HTTPError:
+                raise  # protocol-level answer (404/501): not transient
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                conn.close()
+                self._conn_local.conn = None
+                last = e
+                time.sleep(0.05 * (attempt + 1))
+        raise last
 
     def _open_with_retry(self, req: urllib.request.Request):
         """Transient-failure retry: a whole job's workers hit the server
@@ -335,6 +590,50 @@ class HTTPStoreClient(Store):
             if t0 is not None:
                 timeline_mod.control_span_since(
                     "rendezvous_client", "RVC_GET", t0, scope=scope)
+
+    def batch(self, ops: List[tuple]) -> List[object]:
+        """One signed ``POST /batch`` round-trip for the whole ordered op
+        list (split at the batch-size cap), with graceful degradation: an
+        old-protocol server answers 404 (no /batch route) or 501 (no
+        do_POST at all) and the client falls back to the per-op loop —
+        correct on any server version, just un-coalesced."""
+        from ..core import metrics
+
+        if not ops:
+            return []
+        if not self._batch_enabled or self._batch_unsupported:
+            return super().batch(ops)
+        results: List[object] = []
+        for i in range(0, len(ops), self._batch_max_ops):
+            chunk = ops[i:i + self._batch_max_ops]
+            try:
+                results.extend(self._batch_request(chunk))
+            except urllib.error.HTTPError as e:
+                if e.code in (404, 501):
+                    self._batch_unsupported = True
+                    metrics.inc("rendezvous_batch_fallbacks_total")
+                    results.extend(super().batch(ops[i:]))
+                    return results
+                raise
+        return results
+
+    def _batch_request(self, chunk: List[tuple]) -> List[object]:
+        from ..common import faults
+        from ..core import metrics
+        from ..core import timeline as timeline_mod
+
+        if faults.ACTIVE:
+            faults.inject("store.put")  # batches carry the same PUTs
+        body = encode_batch_ops(chunk)
+        metrics.inc("rendezvous_batch_ops_total", len(chunk))
+        t0 = time.monotonic_ns() if timeline_mod.control_active() else None
+        try:
+            return decode_batch_results(
+                self._keepalive_post(BATCH_PATH, body))
+        finally:
+            if t0 is not None:
+                timeline_mod.control_span_since(
+                    "rendezvous_client", "RVC_BATCH", t0, ops=len(chunk))
 
     def delete(self, scope: str, key: str) -> None:
         from ..core import metrics

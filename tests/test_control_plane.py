@@ -171,6 +171,159 @@ def test_journal_disabled_is_plain_memory_store(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# batched transactions (ISSUE 15 tentpole): atomic group journaling
+
+
+def test_batch_group_torn_at_every_offset_is_all_or_nothing(tmp_path):
+    """Truncate the journal at EVERY byte offset of a batched
+    transaction's group frame: replay must land on exactly the pre-batch
+    state (frame torn ⇒ NONE of the group's ops) or the post-batch state
+    (frame intact ⇒ ALL of them) — a partially-applied batch must be
+    unobservable at every single cut point."""
+    jdir = tmp_path / "j"
+    store = DurableMemoryStore(str(jdir), fsync=False,
+                               snapshot_every=10 ** 9)
+    store.set("s", "keep", b"keep-me")
+    store.set("s", "doomed", b"delete-me")
+    state_before = dict(store._data)
+    results = store.batch([
+        ("set", "s", "a", b"alpha"),
+        ("set", "lease", "h0:0", b'{"renewals": 1}'),
+        ("delete", "s", "doomed"),
+        ("get", "s", "keep"),
+        ("set", "s", "a", b"alpha-2"),  # same-key overwrite inside group
+        ("keys", "s"),
+    ])
+    assert results[3] == b"keep-me"
+    assert results[5] == ["a", "keep"]
+    state_after = dict(store._data)
+    assert state_after != state_before
+    store.close()
+
+    jpath = jdir / "journal-00000000"
+    blob = jpath.read_bytes()
+    ends = [end for end, _ in iter_frames(blob)]
+    assert ends[-1] == len(blob)
+    group_start = ends[-2]
+
+    seen = set()
+    for cut in range(group_start, len(blob) + 1):
+        case = tmp_path / f"cut{cut}"
+        shutil.copytree(jdir, case)
+        with open(case / "journal-00000000", "r+b") as f:
+            f.truncate(cut)
+        recovered = DurableMemoryStore(str(case), fsync=False)
+        if recovered._data == state_before:
+            seen.add("none")
+        elif recovered._data == state_after:
+            seen.add("all")
+        else:
+            pytest.fail(f"partial batch visible at cut {cut}: "
+                        f"{recovered._data}")
+        recovered.close()
+        shutil.rmtree(case)
+    assert seen == {"none", "all"}
+
+
+def test_batch_http_roundtrip_per_op_results(monkeypatch):
+    """One signed ``POST /batch`` carries ordered PUT/GET/DELETE/KEYS and
+    returns positional per-op results with the same semantics as the
+    per-op routes."""
+    monkeypatch.setenv("HOROVOD_SECRET_KEY", "cp-test-secret")
+    server = RendezvousServer("127.0.0.1", job_secret=b"cp-test-secret")
+    port = server.start()
+    client = HTTPStoreClient("127.0.0.1", port)
+    results = client.batch([
+        ("set", "s", "a", b"1"),
+        ("set", "s", "b", b"2"),
+        ("get", "s", "a"),
+        ("get", "s", "absent"),
+        ("keys", "s"),
+        ("delete", "s", "a"),
+        ("delete", "s", "a"),  # second delete: already gone
+        ("keys", "s"),
+    ])
+    assert results == [True, True, b"1", None, ["a", "b"],
+                       True, False, ["b"]]
+    assert client._batch_unsupported is False
+    server.stop()
+
+
+def test_batch_falls_back_per_op_against_old_protocol_server(monkeypatch):
+    """A server without the /batch route (old protocol, or the knob held
+    off for A/B) answers 404; the client degrades to per-op calls with
+    identical results and remembers (sticky) not to retry /batch."""
+    monkeypatch.setenv("HOROVOD_SECRET_KEY", "cp-test-secret")
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_BATCH", "0")  # server-side off
+    server = RendezvousServer("127.0.0.1", job_secret=b"cp-test-secret")
+    port = server.start()
+    monkeypatch.delenv("HOROVOD_RENDEZVOUS_BATCH")  # client-side on
+    client = HTTPStoreClient("127.0.0.1", port)
+    ops = [("set", "s", "k", b"v"), ("get", "s", "k"), ("keys", "s"),
+           ("delete", "s", "k"), ("get", "s", "k")]
+    assert client.batch(ops) == [True, b"v", ["k"], True, None]
+    assert client._batch_unsupported is True
+    # Sticky: the second batch goes straight to per-op, still correct.
+    assert client.batch([("set", "s", "x", b"y"), ("get", "s", "x")]) \
+        == [True, b"y"]
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# host-level fan-in failure behavior (docs/control_plane.md)
+
+
+def test_fanin_aggregator_death_degrades_to_direct_push(tmp_path):
+    """The chaos property the fan-in must keep: peers spool only under a
+    LIVE aggregator heartbeat; when the aggregator dies, submit() returns
+    False within ~1.5 periods and the caller pushes directly — the host
+    never goes silent, so no surviving rank's lease expires."""
+    import time as time_mod
+
+    from horovod_tpu.elastic.fanin import HostFanin
+    from horovod_tpu.transport.store import MemoryStore
+
+    store = MemoryStore()
+    period = 0.05
+    spool = str(tmp_path / "spool")
+    agg = HostFanin(store, local_rank=0, period=period, spool_dir=spool)
+    peer = HostFanin(store, local_rank=1, period=period, spool_dir=spool)
+
+    def lease_op(rank, n):
+        return ("set", LEASE_SCOPE, f"h0:{rank}",
+                json.dumps({"renewals": n}).encode())
+
+    # Before the aggregator's first forward there is no heartbeat:
+    # the peer must push directly (False), not trust the spool.
+    assert peer.submit([lease_op(1, 1)]) is False
+    store.batch([lease_op(1, 1)])  # what the caller does on False
+
+    # Aggregator forwards: its own ops + any spooled peer ops land in
+    # ONE batch, and the heartbeat goes live.
+    assert agg.submit([lease_op(0, 1)]) is True
+    assert store.get(LEASE_SCOPE, "h0:0") is not None
+
+    # Live aggregator: the peer's ops are spooled (True) and the NEXT
+    # aggregator period delivers them.
+    assert peer.submit([lease_op(1, 2)]) is True
+    assert agg.submit([lease_op(0, 2)]) is True
+    assert json.loads(store.get(LEASE_SCOPE, "h0:1"))["renewals"] == 2
+
+    # An UNCHANGED spool is not re-forwarded: a dead peer's stale lease
+    # must age out, not be renewed on its behalf.
+    store.delete(LEASE_SCOPE, "h0:1")
+    assert agg.submit([lease_op(0, 3)]) is True
+    assert store.get(LEASE_SCOPE, "h0:1") is None
+
+    # Aggregator dies (stops submitting): once the heartbeat goes stale
+    # the peer degrades to direct pushes — no silence, no hang.
+    time_mod.sleep(2.5 * period)
+    assert peer.submit([lease_op(1, 3)]) is False
+    store.batch([lease_op(1, 3)])
+    assert json.loads(store.get(LEASE_SCOPE, "h0:1"))["renewals"] == 3
+
+
+# ---------------------------------------------------------------------------
 # server restart + keys endpoint
 
 

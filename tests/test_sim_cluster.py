@@ -1,0 +1,107 @@
+"""Simulated-cluster harness (horovod_tpu/sim/, docs/sim_cluster.md):
+determinism of the shaped wire + churn schedule, and an end-to-end churn
+run through the REAL driver and journaled server at small np.  The
+bounded np=128 "large mesh" run lives in ci/chaos.sh (with
+HOROVOD_LOCK_DEBUG=1 and a zero-lock-cycle assertion).
+"""
+
+import json
+
+import pytest
+
+from horovod_tpu.sim.cluster import COORDINATED_ABORT, SimCluster
+from horovod_tpu.sim.wire import OP_OVERHEAD_BYTES, ShapedStore, ShapedWire
+
+
+# ---------------------------------------------------------------------------
+# shaped wire
+
+
+def test_wire_jitter_stream_is_deterministic_per_link():
+    a = ShapedWire("h000", seed=7, latency_s=0.001, jitter_s=0.0005,
+                   bandwidth_bps=1e9)
+    b = ShapedWire("h000", seed=7, latency_s=0.001, jitter_s=0.0005,
+                   bandwidth_bps=1e9)
+    other_link = ShapedWire("h001", seed=7, latency_s=0.001,
+                            jitter_s=0.0005, bandwidth_bps=1e9)
+    seq_a = [a.delay(1024) for _ in range(8)]
+    seq_b = [b.delay(1024) for _ in range(8)]
+    assert seq_a == seq_b
+    assert seq_a != [other_link.delay(1024) for _ in range(8)]
+    # preview() is a pure function: it never consumes the live stream.
+    assert a.preview(1024, 8) == b.preview(1024, 8)
+    assert [round(v, 9) for v in seq_a] != a.preview(1024, 8) or \
+        seq_a == seq_b  # previews restart the stream from the beginning
+
+
+def test_shaped_store_charges_batch_once(monkeypatch):
+    """N ops through ``batch`` cost ONE latency term; the same N ops
+    per-op cost N — the asymmetry the batching A/B measures."""
+    from horovod_tpu.transport.store import MemoryStore
+
+    sleeps = []
+    monkeypatch.setattr("horovod_tpu.sim.wire.time.sleep",
+                        lambda s: sleeps.append(s))
+    wire = ShapedWire("link", seed=0, latency_s=0.010, jitter_s=0.0,
+                      bandwidth_bps=1e9)
+    store = ShapedStore(MemoryStore(), wire)
+    ops = [("set", "s", f"k{i}", b"v") for i in range(10)]
+    assert store.batch(ops) == [True] * 10
+    assert len(sleeps) == 1
+    batched_cost = sleeps[0]
+    sleeps.clear()
+    for _, scope, key, value in ops:
+        store.set(scope, key, value)
+    assert len(sleeps) == 10
+    assert sum(sleeps) > 5 * batched_cost  # latency paid 10x, not 1x
+    assert wire.injected_s == pytest.approx(batched_cost + sum(sleeps))
+    assert store.get("s", "k0") == b"v"
+    # Byte model sanity: bigger payloads cost more on a finite link.
+    slow = ShapedWire("slow", seed=0, latency_s=0.0, jitter_s=0.0,
+                      bandwidth_bps=1e6)
+    assert slow.delay(10 * OP_OVERHEAD_BYTES) > slow.delay(1)
+
+
+# ---------------------------------------------------------------------------
+# schedule + digest determinism (the artifact's reproducibility witness)
+
+
+def test_sim_schedule_and_digest_deterministic_under_seed():
+    a = SimCluster(64, slots_per_host=8, seed=42, trace=False)
+    b = SimCluster(64, slots_per_host=8, seed=42, trace=False)
+    other = SimCluster(64, slots_per_host=8, seed=43, trace=False)
+    assert a.schedule(6) == b.schedule(6)
+    assert a.determinism_digest(6) == b.determinism_digest(6)
+    assert a.determinism_digest(6) != other.determinism_digest(6)
+    # The last event is always the coordinated abort.
+    assert a.schedule(6)[-1] == (COORDINATED_ABORT, None)
+    # Victims come from the static slot layout.
+    for kind, victim in a.schedule(6)[:-1]:
+        assert victim in a.identities
+
+
+# ---------------------------------------------------------------------------
+# end-to-end churn at small np (tier-1 sized; np=128 rides ci/chaos.sh)
+
+
+def test_sim_churn_epochs_and_coordinated_abort_np16(monkeypatch):
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    cluster = SimCluster(16, slots_per_host=8, seed=7, lease_timeout=1.0,
+                         renew_period=0.2)
+    rec = cluster.run(events=3)
+    assert rec["np"] == 16 and rec["hosts"] == 2
+    # Every scheduled event advanced exactly one epoch, abort included.
+    assert rec["final_epoch"] == 3
+    assert [e["epoch"] for e in rec["events"]] == [1, 2, 3]
+    assert rec["events"][-1]["kind"] == COORDINATED_ABORT
+    # The run produced the same attribution document a live run would,
+    # at the required coverage floor.
+    attr = rec["attribution"]
+    assert attr["coverage"] >= 0.90, attr
+    assert attr["phase_share"]["http_roundtrip"] > 0.0
+    assert rec["sim_wire_delay_s"] > 0.0
+    assert rec["journal_bytes"] > 0
+    assert rec["determinism"]["digest"] == \
+        SimCluster(16, slots_per_host=8, seed=7,
+                   trace=False).determinism_digest(3)
+    json.dumps(rec)  # artifact must be JSON-serializable as-is
