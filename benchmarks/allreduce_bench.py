@@ -204,6 +204,11 @@ def main() -> int:
             comp_modes = ["none", "fp16", "bf16"]
         except ImportError:
             comp_modes = ["none", "fp16"]
+        # Lossy codecs ride the same sweep: on a loopback box the win is
+        # bytes, not wall-clock (the A/B harness gives the verdict); the
+        # sweep records both so the scaling model can project wire-bound
+        # topologies from measured numbers.
+        comp_modes += ["int8", "onebit", "topk10"]
         for nbytes in args.sizes:
             for np_ in args.world_sizes:
                 variants = [

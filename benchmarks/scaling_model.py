@@ -64,6 +64,36 @@ def _load_json(name):
     return None
 
 
+def _codec_factor(name: str):
+    """Wire-byte divisor for a HOROVOD_WIRE_COMPRESSION value, derived
+    from the codec's own ``wire_nbytes`` at the default ring segment on
+    f32 — the same arithmetic the transport uses to frame, so the model
+    input cannot drift from the implementation.  Returns None for an
+    unknown codec name."""
+    if name in ("fp16", "bf16"):
+        return 2.0
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    import numpy as np
+
+    from horovod_tpu.backend import compression as comp_mod
+
+    if name == "int8":
+        comp = comp_mod.Int8Compressor()
+    elif name == "onebit":
+        comp = comp_mod.OneBitCompressor()
+    else:
+        m = comp_mod._TOPK_RE.match(name)
+        if m is None or not 1 <= int(m.group(1)) <= 100:
+            return None
+        comp = comp_mod.TopKCompressor(int(m.group(1)))
+    dtype = np.dtype(np.float32)
+    from horovod_tpu.common.env import DEFAULT_RING_SEGMENT_BYTES
+    n = DEFAULT_RING_SEGMENT_BYTES // dtype.itemsize
+    return n * dtype.itemsize / comp.wire_nbytes(n, dtype)
+
+
 def project(step_ms: float, grad_bytes: int, n: int, busbw_gbs: float,
             cycle_ms: float, dispatch_ms: float,
             wfbp_overhead_ms: float, compression_factor: float = 1.0,
@@ -114,6 +144,12 @@ def main() -> int:
     p.add_argument("--compression-factor", type=float, default=1.0,
                    help="wire-byte divisor from HOROVOD_WIRE_COMPRESSION "
                         "(2.0 for fp16/bf16 on f32 grads, 1.0 = raw)")
+    p.add_argument("--codec", default=None,
+                   help="derive --compression-factor from a codec's "
+                        "wire_nbytes ratio on f32 at the default ring "
+                        "segment (any HOROVOD_WIRE_COMPRESSION value: "
+                        "fp16|bf16|int8|onebit|topk<K>) instead of "
+                        "hand-computing it")
     p.add_argument("--local-size", type=int, default=1,
                    help="chips per host: >1 switches to the hierarchical "
                         "cut — intra-host phase at --intra-busbw-gbs "
@@ -126,6 +162,15 @@ def main() -> int:
                         "json for this box's measured shm-vs-tcp ratio)")
     p.add_argument("--out", default=None)
     args = p.parse_args()
+    if args.codec is not None:
+        if args.compression_factor != 1.0:
+            p.error("--codec derives the factor; don't also pass "
+                    "--compression-factor")
+        factor = _codec_factor(args.codec)
+        if factor is None:
+            p.error(f"unknown --codec {args.codec!r} (expected "
+                    "fp16|bf16|int8|onebit|topk<K>, K in [1, 100])")
+        args.compression_factor = factor
     if args.compression_factor <= 0:
         p.error("--compression-factor must be positive")
     if args.local_size < 1:
@@ -182,7 +227,8 @@ def main() -> int:
         "model": "analytic ring-allreduce projection (see module docstring)",
         "assumptions": {
             "busbw_gbs": args.busbw_gbs,
-            "compression_factor": args.compression_factor,
+            "compression_factor": round(args.compression_factor, 4),
+            "compression_codec": args.codec,
             "local_size": args.local_size,
             "intra_busbw_gbs": (args.intra_busbw_gbs
                                 if args.local_size > 1 else None),

@@ -27,20 +27,42 @@ f32's range with ~8 mantissa bits and casts at memory bandwidth via
 ml_dtypes.  ``HOROVOD_WIRE_COMPRESSION`` selects (all ranks must agree);
 only f32/f64 payloads compress — other dtypes pass through raw.
 
-``residual`` is the error-feedback hook: called with the wide segment and
-its just-compressed narrow image, it may carry quantization error into
-the next step.  The base implementation is a no-op — the hook exists so
-an error-feedback compressor is a subclass, not a transport change.
+Beyond the casts, three LOSSY byte codecs ride the same knob
+(``HOROVOD_WIRE_COMPRESSION=int8|onebit|topk<K>``), the 1-bit-SGD /
+error-feedback family (Seide et al. 2014; Karimireddy et al. 2019):
+
+- **int8** — per-segment symmetric quantization: ``<f4 scale>`` prefix
+  then one signed byte per element (``q = clip(round(x/scale), ±127)``,
+  ``scale = max|x|/127``).  ~4× on f32.
+- **onebit** — sign bits packed 8:1 plus per-segment positive/negative
+  means: ``<f4 pos_mean><f4 neg_mean>`` then ``ceil(n/8)`` sign bytes.
+  ~32× on f32.
+- **topk<K>** — only the K% largest-magnitude elements travel, as
+  ``<u4 index><work-dtype value>`` pairs (``k = max(1, n*K//100)`` per
+  segment — deterministic, so both peers frame identically).
+
+Lossy codecs are BYTE codecs, not casts: compressed segments have
+codec-specific sizes (``wire_nbytes``), which every rank derives from
+the shared segment bounds + knobs, so the transport's exact-size frame
+contract holds even for the variable-length topk path.  Convergence
+safety comes from per-tensor ERROR FEEDBACK (:class:`EfState`): the
+residual ``x - decode(encode(x))`` of step *t* is added back into the
+same segment before quantizing at step *t+1*, keyed by (tensor key,
+compress sequence), reset on shape change and on re-init.
 
 Costs are first-class observables: cast seconds accumulate in
 ``wire_compress_seconds_total`` and narrow payload bytes in the
 ``compressed_bytes`` wire stat (surfaced as
 ``wire_compressed_bytes_total``) — the "half the bytes" claim is
-counter-asserted in tests, not wall-clock-argued.
+counter-asserted in tests, not wall-clock-argued.  Lossy codecs add
+``wire_codec_bytes_total{codec=}`` (bytes produced per codec),
+``wire_ef_residual_bytes`` (EF state held), and
+``wire_ef_flush_seconds_total`` (the EF fold/carry cost).
 """
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Optional
 
@@ -50,11 +72,22 @@ from ..common import env as env_mod
 from ..common.exceptions import HorovodInternalError
 from ..core import metrics
 from ..core.timeline import wire_stats
+from ..transport.frame_bits import (_WIRE_DTYPE_BF16, _WIRE_DTYPE_FP16,
+                                    _WIRE_DTYPE_INT8, _WIRE_DTYPE_ONEBIT,
+                                    _WIRE_DTYPE_RAW, _WIRE_DTYPE_TOPK)
 
 # Wire dtype codes carried in the frame header (3 bits; 0 = raw).
-WIRE_DTYPE_RAW = 0
-WIRE_DTYPE_FP16 = 1
-WIRE_DTYPE_BF16 = 2
+# Values live in transport/frame_bits.py (the HVD008-closed registry);
+# these are the compression-plane aliases every caller imports.
+WIRE_DTYPE_RAW = _WIRE_DTYPE_RAW
+WIRE_DTYPE_FP16 = _WIRE_DTYPE_FP16
+WIRE_DTYPE_BF16 = _WIRE_DTYPE_BF16
+WIRE_DTYPE_INT8 = _WIRE_DTYPE_INT8
+WIRE_DTYPE_ONEBIT = _WIRE_DTYPE_ONEBIT
+WIRE_DTYPE_TOPK = _WIRE_DTYPE_TOPK
+
+#: little-endian f4 — the scale/mean prefix dtype every peer agrees on
+_F4 = np.dtype("<f4")
 
 #: Work dtypes eligible for narrowing; everything else travels raw.
 _COMPRESSIBLE = (np.dtype(np.float32), np.dtype(np.float64))
@@ -66,9 +99,22 @@ class WireCompressor:
     #: knob value and frame-header code (subclasses set these)
     name: str = "none"
     code: int = WIRE_DTYPE_RAW
+    #: byte codecs (int8/onebit/topk) set True: segments travel as
+    #: codec-sized byte blobs, not element-for-element casts, and the
+    #: ring takes the encode/decode + byte-forwarding path instead of
+    #: compress/decompress + quantize_inplace.
+    lossy: bool = False
 
     def __init__(self, wire_dtype: np.dtype):
         self.wire_dtype = np.dtype(wire_dtype)
+
+    def wire_nbytes(self, n: int, dtype: np.dtype) -> int:
+        """Compressed byte size of an ``n``-element segment of work dtype
+        ``dtype`` — deterministic from (n, dtype, knobs) alone, so both
+        endpoints of a link frame identically (the transport enforces
+        exact frame sizes; this is the allgather-v style sizing the
+        variable-length codecs need)."""
+        return n * self.wire_dtype.itemsize
 
     @staticmethod
     def _account(t0: float, nbytes: int) -> None:
@@ -88,6 +134,9 @@ class WireCompressor:
             dst[:] = src
         self.residual(src, dst)
         self._account(t0, dst.nbytes)
+        if metrics.ENABLED:
+            metrics.inc("wire_codec_bytes_total", dst.nbytes,
+                        codec=self.name)
         return dst
 
     def decompress_add(self, wire_seg: np.ndarray,
@@ -152,7 +201,252 @@ class Bf16Compressor(WireCompressor):
         super().__init__(np.dtype(ml_dtypes.bfloat16))
 
 
-_COMPRESSORS = {"fp16": Fp16Compressor, "bf16": Bf16Compressor}
+class EfState:
+    """Per-tensor error-feedback residual accumulators.
+
+    The ring compresses a deterministic SEQUENCE of segments per
+    allreduce (reduce-scatter steps × pipeline segments), and that
+    sequence replays identically at the next iteration of the same fused
+    tensor (same bounds, same knobs) — so a residual slot is keyed by
+    (tensor key, position in the compress sequence).  ``begin`` rewinds
+    the sequence counter at the top of each allreduce; ``take`` hands the
+    slot's residual to the codec, creating (or resetting to) zeros when
+    the slot is new or the segment's shape/dtype changed — a re-fused or
+    re-sharded tensor must not absorb a stale residual.  State is owned
+    by the collective op instance, so elastic re-initialization (a new
+    op) drops every accumulator — recovery replay starts from the same
+    zero state a fresh run does.
+    """
+
+    def __init__(self):
+        self._slots: dict = {}
+        self._key = None
+        self._seq = 0
+        self._nbytes = 0
+
+    def begin(self, key) -> None:
+        self._key = key
+        self._seq = 0
+
+    def take(self, n: int, dtype: np.dtype) -> np.ndarray:
+        slot = (self._key, self._seq)
+        self._seq += 1
+        r = self._slots.get(slot)
+        if r is None or r.size != n or r.dtype != dtype:
+            if r is not None:
+                self._nbytes -= r.nbytes
+            r = np.zeros(n, dtype=dtype)
+            self._slots[slot] = r
+            self._nbytes += r.nbytes
+            if metrics.ENABLED:
+                metrics.set_gauge("wire_ef_residual_bytes", self._nbytes)
+        return r
+
+
+def ef_enabled() -> bool:
+    """Error feedback on/off (HOROVOD_WIRE_EF, default on).  Off exists
+    for the convergence control arm: without the accumulator the lossy
+    codecs' bias goes uncorrected, which the np=2 convergence test
+    asserts is detectably worse — the accumulator is load-bearing."""
+    return env_mod.get_bool(env_mod.HOROVOD_WIRE_EF, True)
+
+
+class LossyWireCompressor(WireCompressor):
+    """Byte-codec base: encode/decode between wide segments and
+    codec-framed byte blobs, with optional error feedback.
+
+    Unlike the casts, decode∘encode is NOT provably idempotent (float
+    scale round trips), so cross-rank bit-identity is the ring's job:
+    the allgather owner encodes its reduced chunk ONCE, decodes its own
+    bytes back, and every hop forwards the bytes verbatim
+    (``cpu_ring._ring_allgather_bytes``) — all ranks decode identical
+    bytes by construction.  Codec scratch lives in a small per-instance
+    pool (persistent, grown on demand), not per-call allocations."""
+
+    lossy = True
+
+    def __init__(self):
+        super().__init__(np.dtype(np.uint8))
+        self._pool: dict = {}
+
+    def _scratch(self, tag: str, n: int, dtype: np.dtype) -> np.ndarray:
+        key = (tag, np.dtype(dtype))
+        a = self._pool.get(key)
+        if a is None or a.size < n:
+            a = np.empty(max(n, 1), dtype)
+            self._pool[key] = a
+        return a[:n]
+
+    # -- codec payload (subclasses implement) ---------------------------
+
+    def _encode(self, src: np.ndarray, out: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def _decode(self, wire: np.ndarray, out: np.ndarray) -> None:
+        raise NotImplementedError
+
+    # -- ring-facing API ------------------------------------------------
+
+    def encode(self, src: np.ndarray, out: np.ndarray,
+               ef: Optional[EfState] = None) -> None:
+        """Quantize the wide segment ``src`` into the byte buffer ``out``
+        (exactly ``wire_nbytes(src.size, src.dtype)`` bytes).  With
+        ``ef``, the slot's carried residual is added back BEFORE
+        quantizing and the new quantization error is stored after —
+        ``src`` itself is never mutated."""
+        t0 = time.perf_counter()
+        if ef is not None:
+            r = ef.take(src.size, src.dtype)
+            adj = self._scratch("ef-adj", src.size, src.dtype)
+            np.add(src, r, out=adj)
+        else:
+            adj = src
+        self._encode(adj, out)
+        if ef is not None:
+            t1 = time.perf_counter()
+            dec = self._scratch("ef-dec", src.size, src.dtype)
+            self._decode(out, dec)
+            np.subtract(adj, dec, out=r)
+            if metrics.ENABLED:
+                metrics.inc("wire_ef_flush_seconds_total",
+                            time.perf_counter() - t1)
+        self._account(t0, out.nbytes)
+        if metrics.ENABLED:
+            metrics.inc("wire_codec_bytes_total", out.nbytes,
+                        codec=self.name)
+
+    def decode_add(self, wire: np.ndarray, out_seg: np.ndarray) -> None:
+        """``out_seg += decode(wire)`` — the reduce-scatter landing."""
+        t0 = time.perf_counter()
+        dec = self._scratch("dec", out_seg.size, out_seg.dtype)
+        self._decode(wire, dec)
+        np.add(out_seg, dec, out=out_seg)
+        self._account(t0, wire.nbytes)
+
+    def decode_into(self, wire: np.ndarray, out_seg: np.ndarray) -> None:
+        """``out_seg[:] = decode(wire)`` — the allgather restore (and the
+        owner's own decode of its encoded chunk)."""
+        t0 = time.perf_counter()
+        self._decode(wire, out_seg)
+        self._account(t0, wire.nbytes)
+
+
+class Int8Compressor(LossyWireCompressor):
+    """Per-segment symmetric int8: ``<f4 scale>`` + one s8/element."""
+
+    name = "int8"
+    code = WIRE_DTYPE_INT8
+
+    def wire_nbytes(self, n: int, dtype: np.dtype) -> int:
+        return _F4.itemsize + n
+
+    def _encode(self, src, out):
+        n = src.size
+        mag = self._scratch("mag", n, src.dtype)
+        np.abs(src, out=mag)
+        scale = np.float32(float(mag.max()) / 127.0) if n else np.float32(0)
+        out[:4] = np.frombuffer(scale.astype(_F4).tobytes(), np.uint8)
+        q = out[4:4 + n].view(np.int8)
+        if scale:
+            # Multiply by the reciprocal (multiply streams ~2x faster
+            # than divide) and skip clipping: |x| <= max means
+            # |x/scale| <= 127 by construction, and rint cannot push a
+            # value past it.  rint(x * (1/scale)) rounds one ulp
+            # differently from rint(x / scale) for a handful of inputs —
+            # irrelevant, both are valid quantizations and every rank
+            # decodes the same bytes.
+            np.multiply(src, src.dtype.type(1.0 / np.float64(scale)),
+                        out=mag)
+            np.rint(mag, out=mag)
+            np.clip(mag, -127, 127, out=mag)  # inf/nan inputs only
+            q[:] = mag  # integral-valued floats: cast is exact
+        else:
+            q[:] = 0
+
+    def _decode(self, wire, out):
+        n = out.size
+        scale = np.frombuffer(wire[:4].tobytes(), _F4)[0]
+        q = wire[4:4 + n].view(np.int8)
+        np.multiply(q, out.dtype.type(scale), out=out)
+
+
+class OneBitCompressor(LossyWireCompressor):
+    """Sign bits packed 8:1 + per-segment positive/negative means:
+    ``<f4 pos_mean><f4 neg_mean>`` then ``ceil(n/8)`` sign bytes (bit 1 =
+    non-negative → pos_mean, bit 0 → neg_mean)."""
+
+    name = "onebit"
+    code = WIRE_DTYPE_ONEBIT
+
+    def wire_nbytes(self, n: int, dtype: np.dtype) -> int:
+        return 2 * _F4.itemsize + (n + 7) // 8
+
+    def _encode(self, src, out):
+        n = src.size
+        pos = np.greater_equal(src, 0)
+        npos = int(pos.sum())
+        total = float(src.sum(dtype=np.float64))
+        pos_sum = float(src[pos].sum(dtype=np.float64)) if npos else 0.0
+        pos_mean = pos_sum / npos if npos else 0.0
+        neg_mean = (total - pos_sum) / (n - npos) if n - npos else 0.0
+        hdr = np.array([pos_mean, neg_mean], _F4)
+        out[:8] = hdr.view(np.uint8)
+        out[8:8 + (n + 7) // 8] = np.packbits(pos)
+
+    def _decode(self, wire, out):
+        n = out.size
+        means = np.frombuffer(wire[:8].tobytes(), _F4)
+        bits = np.unpackbits(wire[8:8 + (n + 7) // 8], count=n)
+        out[:] = out.dtype.type(means[1])
+        out[bits.astype(bool)] = out.dtype.type(means[0])
+
+
+class TopKCompressor(LossyWireCompressor):
+    """Magnitude top-k sparsification: only ``k = max(1, n*K//100)``
+    elements per segment travel, as packed ``<u4 index><work-dtype
+    value>`` records; everything else decodes to zero (its mass rides
+    the EF accumulator into later steps)."""
+
+    code = WIRE_DTYPE_TOPK
+
+    def __init__(self, density_pct: int):
+        super().__init__()
+        self.density_pct = int(density_pct)
+        self.name = f"topk{self.density_pct}"
+
+    def _k(self, n: int) -> int:
+        return max(1, n * self.density_pct // 100) if n else 0
+
+    def _pair(self, dtype: np.dtype) -> np.dtype:
+        return np.dtype([("i", "<u4"), ("v", np.dtype(dtype))])
+
+    def wire_nbytes(self, n: int, dtype: np.dtype) -> int:
+        return self._k(n) * self._pair(dtype).itemsize
+
+    def _encode(self, src, out):
+        n, k = src.size, self._k(src.size)
+        mag = self._scratch("mag", n, src.dtype)
+        np.abs(src, out=mag)
+        if k < n:
+            idx = np.sort(np.argpartition(mag, n - k)[n - k:])
+        else:
+            idx = np.arange(n)
+        rec = out[:k * self._pair(src.dtype).itemsize] \
+            .view(self._pair(src.dtype))
+        rec["i"] = idx
+        rec["v"] = src[idx]
+
+    def _decode(self, wire, out):
+        k = self._k(out.size)
+        rec = wire[:k * self._pair(out.dtype).itemsize] \
+            .view(self._pair(out.dtype))
+        out[:] = 0
+        out[rec["i"].astype(np.intp)] = rec["v"]
+
+
+_COMPRESSORS = {"fp16": Fp16Compressor, "bf16": Bf16Compressor,
+                "int8": Int8Compressor, "onebit": OneBitCompressor}
+_TOPK_RE = re.compile(r"^topk(\d+)$")
 _cache: dict = {}
 
 
@@ -164,12 +458,20 @@ def wire_compressor_for(dtype: np.dtype) -> Optional[WireCompressor]:
         or "none"
     if name == "none":
         return None
-    if name not in _COMPRESSORS:
+    topk = _TOPK_RE.match(name)
+    if topk is not None:
+        density = int(topk.group(1))
+        if not 1 <= density <= 100:
+            raise HorovodInternalError(
+                f"HOROVOD_WIRE_COMPRESSION {name!r}: topk density must "
+                "be an integer percentage in [1, 100] (e.g. topk10)")
+    elif name not in _COMPRESSORS:
         raise HorovodInternalError(
             f"unknown HOROVOD_WIRE_COMPRESSION {name!r} "
-            f"(expected none|{'|'.join(sorted(_COMPRESSORS))})")
+            f"(expected none|{'|'.join(sorted(_COMPRESSORS))}|topk<K>)")
     if np.dtype(dtype) not in _COMPRESSIBLE:
         return None
     if name not in _cache:
-        _cache[name] = _COMPRESSORS[name]()
+        _cache[name] = TopKCompressor(int(topk.group(1))) \
+            if topk is not None else _COMPRESSORS[name]()
     return _cache[name]

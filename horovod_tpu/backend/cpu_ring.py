@@ -231,7 +231,8 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
                    reduce_to: Optional[np.ndarray] = None,
                    wide: Optional[np.dtype] = None,
                    compressor=None,
-                   fbm: Optional[FusionBufferManager] = None) -> None:
+                   fbm: Optional[FusionBufferManager] = None,
+                   ef=None, wire_code: int = 0) -> None:
     """One zero-copy, segment-pipelined ring step — the primitive every
     host collective builds on.
 
@@ -263,11 +264,21 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
     in a narrow arena and widen during the reduce (or restore, allgather
     phase) — ``recv_arr`` then only defines the logical element layout.
     The frame header carries the wire dtype code, so a peer with a
-    different ``HOROVOD_WIRE_COMPRESSION`` aborts loudly."""
+    different ``HOROVOD_WIRE_COMPRESSION`` aborts loudly.
+
+    Lossy codecs (``compressor.lossy``): segments travel as codec-framed
+    BYTE blobs whose per-segment sizes both endpoints derive from
+    ``wire_nbytes`` (the transport's exact-size contract holds even for
+    variable-length topk); ``ef`` is the per-tensor error-feedback state
+    threaded into every encode.  ``wire_code`` stamps a dtype code on a
+    RAW (compressor-less) exchange — the byte-forwarding allgather sends
+    already-encoded blobs verbatim but must keep the skew detector
+    armed."""
     seg = _segment_elems(send_arr.dtype)
     sn, rn = int(send_arr.size), int(recv_arr.size)
     n_send = -(-sn // seg)
     n_recv = -(-rn // seg)
+    lossy = compressor is not None and getattr(compressor, "lossy", False)
     # Deferred-ness is a PER-LINK question (transport/select.py): under a
     # mixed mesh the send direction may ride shm (CRC default off, no
     # digests) while the recv direction rides TCP (shadow digests on) —
@@ -277,9 +288,31 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
         if n_send and mesh.deferred_digests_for(nxt) else None
     recv_dig = mesh.new_digest() \
         if n_recv and mesh.deferred_digests_for(prv) else None
-    code = 0
+    code = wire_code
     send_stage = recv_stage = None
-    if compressor is not None:
+    send_nb = recv_offs = None
+    if lossy:
+        code = compressor.code
+        # Per-segment compressed byte sizes (the last segment may be
+        # short); both endpoints derive the identical layout from the
+        # shared bounds + knobs, never from the bytes themselves.
+        wnb = compressor.wire_nbytes
+        send_nb = [wnb(min(sn, (k + 1) * seg) - k * seg, send_arr.dtype)
+                   for k in range(n_send)]
+        recv_nb = [wnb(min(rn, (k + 1) * seg) - k * seg, recv_arr.dtype)
+                   for k in range(n_recv)]
+        recv_offs = [0]
+        for b in recv_nb:
+            recv_offs.append(recv_offs[-1] + b)
+        sse = max(send_nb) if send_nb else 1
+        rse = recv_offs[-1] if recv_nb else 1
+        if fbm is not None:
+            send_stage = fbm.get(np.uint8, sse, key="wire-send")
+            recv_stage = fbm.get(np.uint8, rse, key="wire-recv")
+        else:
+            send_stage = np.empty(sse, dtype=np.uint8)
+            recv_stage = np.empty(rse, dtype=np.uint8)
+    elif compressor is not None:
         code = compressor.code
         wdt = compressor.wire_dtype
         # Send staging is one segment (``send`` returns only after the
@@ -302,14 +335,22 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
         if k < n_recv:
             lo = k * seg
             hi = min(rn, lo + seg)
-            dest = recv_stage[lo:hi] if compressor is not None \
-                else recv_arr[lo:hi]
+            if lossy:
+                dest = recv_stage[recv_offs[k]:recv_offs[k + 1]]
+            elif compressor is not None:
+                dest = recv_stage[lo:hi]
+            else:
+                dest = recv_arr[lo:hi]
             cur = mesh.recv_into_async(prv, _byte_view(dest),
                                        digest=recv_dig, wire_dtype=code)
         if k < n_send:
             lo = k * seg
             src = send_arr[lo:min(sn, lo + seg)]
-            if compressor is not None:
+            if lossy:
+                blob = send_stage[:send_nb[k]]
+                compressor.encode(src, blob, ef)
+                src = blob
+            elif compressor is not None:
                 src = compressor.compress(src, send_stage)
             mesh.send(nxt, _byte_view(src), digest=send_dig,
                       wire_dtype=code)
@@ -317,7 +358,13 @@ def _ring_exchange(mesh: TcpMesh, nxt: int, prv: int,
             prev_h.wait()
             lo = prev_k * seg
             hi = min(rn, lo + seg)
-            if compressor is not None:
+            if lossy:
+                blob = recv_stage[recv_offs[prev_k]:recv_offs[prev_k + 1]]
+                if reduce_to is not None:
+                    compressor.decode_add(blob, reduce_to[lo:hi])
+                else:
+                    compressor.decode_into(blob, recv_arr[lo:hi])
+            elif compressor is not None:
                 if reduce_to is not None:
                     compressor.decompress_add(recv_stage[lo:hi],
                                               reduce_to[lo:hi])
@@ -339,7 +386,8 @@ def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
                          idx: int, wide: np.dtype,
                          fbm: Optional[FusionBufferManager] = None,
                          compressor=None,
-                         lc_name: Optional[str] = None) -> np.ndarray:
+                         lc_name: Optional[str] = None,
+                         ef=None) -> np.ndarray:
     """Segment-pipelined ring reduce-scatter over ``group`` (ordered
     global ranks; ``idx`` is our position).  Returns the chunk bounds;
     afterwards position ``idx`` owns the fully reduced chunk
@@ -349,7 +397,9 @@ def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
     per-step allocation) and the only per-byte work on the hot path is
     the widened numpy add — zero heap copies per step.  With
     ``compressor``, segments travel narrow and the add widens straight
-    out of the narrow staging (``backend/compression.py``)."""
+    out of the narrow staging (``backend/compression.py``).  ``ef`` is
+    the error-feedback accumulator threaded into every lossy encode —
+    reduce-scatter sends are the only place residuals are folded back."""
     g = len(group)
     bounds = _chunk_bounds(buf.size, g)
     nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
@@ -368,7 +418,7 @@ def _ring_reduce_scatter(mesh: TcpMesh, buf: np.ndarray, group: List[int],
         _ring_exchange(mesh, nxt, prv,
                        buf[bounds[send_c]:bounds[send_c + 1]],
                        stage[:chunk.size], reduce_to=chunk, wide=wide,
-                       compressor=compressor, fbm=fbm)
+                       compressor=compressor, fbm=fbm, ef=ef)
         if lc_name is not None:
             timeline_mod.lifecycle_end(lc_name, "LC_RS_STEP")
     return bounds
@@ -397,6 +447,55 @@ def _ring_allgather_chunks(mesh: TcpMesh, buf: np.ndarray, group: List[int],
                        buf[bounds[send_c]:bounds[send_c + 1]],
                        buf[bounds[recv_c]:bounds[recv_c + 1]],
                        compressor=compressor, fbm=fbm)
+        if lc_name is not None:
+            timeline_mod.lifecycle_end(lc_name, "LC_AG_STEP")
+
+
+def _ring_allgather_bytes(mesh: TcpMesh, buf: np.ndarray, group: List[int],
+                          idx: int, bounds: np.ndarray, compressor,
+                          fbm: Optional[FusionBufferManager] = None,
+                          lc_name: Optional[str] = None) -> None:
+    """Byte-forwarding ring allgather for LOSSY codecs.  The owner of
+    each chunk encodes it ONCE (no error feedback — the residual was
+    already folded in during reduce-scatter) and decodes its own bytes
+    back in place; every subsequent hop forwards the received byte blob
+    VERBATIM and decodes a copy locally.  All ranks therefore decode the
+    exact same bytes for every chunk — bit-identical by construction,
+    which is stronger than re-encoding at each hop (lossy encode∘decode
+    is not provably idempotent the way fp16/bf16 casts are).  Compressed
+    chunk sizes come from ``wire_nbytes`` on the shared bounds, so the
+    variable-length topk frames keep the exact-size wire contract."""
+    g = len(group)
+    nxt, prv = group[(idx + 1) % g], group[(idx - 1) % g]
+    sizes = [compressor.wire_nbytes(int(bounds[c + 1] - bounds[c]),
+                                    buf.dtype)
+             if bounds[c + 1] > bounds[c] else 0 for c in range(g)]
+    arena = max(sizes) if sizes else 0
+    if arena == 0:
+        return
+    if fbm is not None:
+        hold = fbm.get(np.uint8, arena, key="wire-ag-hold")
+        land = fbm.get(np.uint8, arena, key="wire-ag-land")
+    else:
+        hold = np.empty(arena, dtype=np.uint8)
+        land = np.empty(arena, dtype=np.uint8)
+    own = (idx + 1) % g
+    chunk = buf[bounds[own]:bounds[own + 1]]
+    if chunk.size:
+        compressor.encode(chunk, hold[:sizes[own]])
+        compressor.decode_into(hold[:sizes[own]], chunk)
+    for s in range(g - 1):
+        send_c = (idx + 1 - s) % g
+        recv_c = (idx - s) % g
+        if lc_name is not None:
+            timeline_mod.lifecycle_begin(lc_name, "LC_AG_STEP")
+        _ring_exchange(mesh, nxt, prv, hold[:sizes[send_c]],
+                       land[:sizes[recv_c]], fbm=fbm,
+                       wire_code=compressor.code)
+        if sizes[recv_c]:
+            compressor.decode_into(land[:sizes[recv_c]],
+                                   buf[bounds[recv_c]:bounds[recv_c + 1]])
+        hold, land = land, hold
         if lc_name is not None:
             timeline_mod.lifecycle_end(lc_name, "LC_AG_STEP")
 
@@ -439,7 +538,11 @@ class RingAllreduce(CollectiveOp):
             _scale_inplace(work, response.prescale_factor, wide)
 
         if self.topo.size > 1:
-            work = self._ring_allreduce(work, wide, lc)
+            # Error-feedback accumulators are keyed by the fused tensor
+            # set: the same fusion replays the same compress sequence, so
+            # residuals line up with the segments that produced them.
+            ef_key = tuple(e.tensor_name for e in entries)
+            work = self._ring_allreduce(work, wide, lc, ef_key=ef_key)
 
         if response.postscale_factor != 1.0:
             _scale_inplace(work, response.postscale_factor, wide)
@@ -449,26 +552,51 @@ class RingAllreduce(CollectiveOp):
         _lc_span(lc, "LC_UNFUSE", False)
         return Status.OK()
 
+    def _ef_for(self, comp, ef_key):
+        """Per-op error-feedback state, lazily created.  Owned by the op
+        instance so an elastic re-init drops stale residuals along with
+        the op — surviving ranks and joiners agree on empty accumulators,
+        which the bit-identical recovery proof depends on."""
+        from .compression import EfState, ef_enabled
+
+        if comp is None or not getattr(comp, "lossy", False) \
+                or not ef_enabled():
+            return None
+        ef = getattr(self, "_ef_state", None)
+        if ef is None:
+            ef = self._ef_state = EfState()
+        ef.begin(ef_key)
+        return ef
+
     def _ring_allreduce(self, buf: np.ndarray, wide: np.dtype,
-                        lc_names: List[str] = ()) -> np.ndarray:
+                        lc_names: List[str] = (),
+                        ef_key=()) -> np.ndarray:
         from .compression import wire_compressor_for
 
         group = list(range(self.topo.size))
         comp = wire_compressor_for(buf.dtype)
+        lossy = comp is not None and getattr(comp, "lossy", False)
+        ef = self._ef_for(comp, ef_key)
         step_lane = lc_names[0] if lc_names else None
         _lc_span(lc_names, "LC_WIRE_REDUCE_SCATTER", True)
         bounds = _ring_reduce_scatter(
             self.mesh, buf, group, self.topo.rank, wide,
-            self.fusion_buffers, compressor=comp, lc_name=step_lane)
+            self.fusion_buffers, compressor=comp, lc_name=step_lane,
+            ef=ef)
         _lc_span(lc_names, "LC_WIRE_REDUCE_SCATTER", False)
-        if comp is not None:
-            own = (self.topo.rank + 1) % len(group)
-            _quantize_owned(comp, buf[bounds[own]:bounds[own + 1]],
-                            self.fusion_buffers)
         _lc_span(lc_names, "LC_WIRE_ALLGATHER", True)
-        _ring_allgather_chunks(
-            self.mesh, buf, group, self.topo.rank, bounds,
-            self.fusion_buffers, compressor=comp, lc_name=step_lane)
+        if lossy:
+            _ring_allgather_bytes(
+                self.mesh, buf, group, self.topo.rank, bounds, comp,
+                self.fusion_buffers, lc_name=step_lane)
+        else:
+            if comp is not None:
+                own = (self.topo.rank + 1) % len(group)
+                _quantize_owned(comp, buf[bounds[own]:bounds[own + 1]],
+                                self.fusion_buffers)
+            _ring_allgather_chunks(
+                self.mesh, buf, group, self.topo.rank, bounds,
+                self.fusion_buffers, compressor=comp, lc_name=step_lane)
         _lc_span(lc_names, "LC_WIRE_ALLGATHER", False)
         return buf
 
@@ -503,11 +631,17 @@ class HierarchicalAllreduce(RingAllreduce):
                 + topo.local_rank)
 
     def _ring_allreduce(self, buf: np.ndarray, wide: np.dtype,
-                        lc_names: List[str] = ()) -> np.ndarray:
+                        lc_names: List[str] = (),
+                        ef_key=()) -> np.ndarray:
         from .compression import wire_compressor_for
 
         t = self.topo
         comp = wire_compressor_for(buf.dtype)
+        lossy = comp is not None and getattr(comp, "lossy", False)
+        # One EF sequence spans the local AND cross reduce-scatters —
+        # ``begin`` rewinds the counter once per allreduce and the two
+        # phases replay their encodes in a fixed order.
+        ef = self._ef_for(comp, ef_key)
         local_group = [t.cross_rank * t.local_size + l
                        for l in range(t.local_size)]
         cross_group = [c * t.local_size + t.local_rank
@@ -517,7 +651,8 @@ class HierarchicalAllreduce(RingAllreduce):
         _lc_span(lc_names, "LC_WIRE_REDUCE_SCATTER", True)
         bounds = _ring_reduce_scatter(
             self.mesh, buf, local_group, t.local_rank, wide,
-            self.fusion_buffers, compressor=comp, lc_name=step_lane)
+            self.fusion_buffers, compressor=comp, lc_name=step_lane,
+            ef=ef)
         _lc_span(lc_names, "LC_WIRE_REDUCE_SCATTER", False)
         own = (t.local_rank + 1) % t.local_size
         seg = buf[bounds[own]:bounds[own + 1]]
@@ -527,25 +662,38 @@ class HierarchicalAllreduce(RingAllreduce):
             _lc_span(lc_names, "LC_WIRE_CROSS", True)
             seg_bounds = _ring_reduce_scatter(
                 self.mesh, seg, cross_group, t.cross_rank, wide,
-                self.fusion_buffers, compressor=comp)
-            if comp is not None:
-                own_c = (t.cross_rank + 1) % t.cross_size
-                _quantize_owned(
-                    comp, seg[seg_bounds[own_c]:seg_bounds[own_c + 1]],
-                    self.fusion_buffers)
-            _ring_allgather_chunks(
-                self.mesh, seg, cross_group, t.cross_rank, seg_bounds,
-                self.fusion_buffers, compressor=comp)
+                self.fusion_buffers, compressor=comp, ef=ef)
+            if lossy:
+                _ring_allgather_bytes(
+                    self.mesh, seg, cross_group, t.cross_rank,
+                    seg_bounds, comp, self.fusion_buffers)
+            else:
+                if comp is not None:
+                    own_c = (t.cross_rank + 1) % t.cross_size
+                    _quantize_owned(
+                        comp,
+                        seg[seg_bounds[own_c]:seg_bounds[own_c + 1]],
+                        self.fusion_buffers)
+                _ring_allgather_chunks(
+                    self.mesh, seg, cross_group, t.cross_rank,
+                    seg_bounds, self.fusion_buffers, compressor=comp)
             _lc_span(lc_names, "LC_WIRE_CROSS", False)
-        if comp is not None:
+        if comp is not None and not lossy:
             # The whole owned chunk goes into the local allgather; parts
             # restored from the wire are already quantized (idempotent),
-            # this pins the cross-phase leftovers.
+            # this pins the cross-phase leftovers.  Lossy codecs skip
+            # this — the local byte-forwarding allgather owner-encodes
+            # the chunk once and every rank decodes those same bytes.
             _quantize_owned(comp, seg, self.fusion_buffers)
         _lc_span(lc_names, "LC_WIRE_ALLGATHER", True)
-        _ring_allgather_chunks(
-            self.mesh, buf, local_group, t.local_rank, bounds,
-            self.fusion_buffers, compressor=comp, lc_name=step_lane)
+        if lossy:
+            _ring_allgather_bytes(
+                self.mesh, buf, local_group, t.local_rank, bounds, comp,
+                self.fusion_buffers, lc_name=step_lane)
+        else:
+            _ring_allgather_chunks(
+                self.mesh, buf, local_group, t.local_rank, bounds,
+                self.fusion_buffers, compressor=comp, lc_name=step_lane)
         _lc_span(lc_names, "LC_WIRE_ALLGATHER", False)
         return buf
 

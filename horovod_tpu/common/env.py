@@ -137,13 +137,23 @@ HOROVOD_WIRE_CRC_SHADOW = "HOROVOD_WIRE_CRC_SHADOW"
 # inline crc32 regardless.  All ranks must agree.
 HOROVOD_WIRE_DIGEST = "HOROVOD_WIRE_DIGEST"
 # -- bandwidth plane (docs/data_plane.md) --
-# Cast-on-the-wire gradient compression for the host-ring allreduce:
-# "none" (default) | "fp16" | "bf16".  f32/f64 payloads are cast per
-# segment into a keyed staging arena at send and restored/reduced in wide
+# Wire gradient compression for the host-ring allreduce: "none"
+# (default) | "fp16" | "bf16" (lossless-ish casts) | "int8" | "onebit" |
+# "topk<K>" (lossy codecs with error feedback; K is the kept density in
+# percent, e.g. "topk10").  f32/f64 payloads are compressed per segment
+# into a keyed staging arena at send and restored/reduced in wide
 # precision on land (backend/compression.py); other dtypes pass through
 # uncompressed.  Frame headers carry the wire dtype code, so ranks that
 # disagree on this knob fail loudly (poisoned stream), not silently.
 HOROVOD_WIRE_COMPRESSION = "HOROVOD_WIRE_COMPRESSION"
+# Error feedback for the LOSSY codecs (int8/onebit/topk), default on:
+# each rank keeps a per-(tensor set, segment) residual accumulator and
+# folds the quantization error of step t back into the segment before
+# encoding at step t+1 — the 1-bit-SGD convergence fix.  "0" disables it
+# (the convergence test's control arm; measurably worse, never faster).
+# No wire format change either way, so ranks may disagree harmlessly —
+# but don't: the convergence guarantee is per-rank.
+HOROVOD_WIRE_EF = "HOROVOD_WIRE_EF"
 # Coordinator fusion-bucket ordering: "readiness" (default — tensors are
 # packed in the order their negotiations were FIRST announced, so early
 # gradients fly while late layers still compute) or "arrival" (the

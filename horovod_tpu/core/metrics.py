@@ -199,6 +199,18 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "wire_compress_seconds_total": (
         "counter", "seconds spent casting payloads to/from the wire "
                    "dtype (compress, widen-reduce, restore, quantize)"),
+    "wire_codec_bytes_total": (
+        "counter", "compressed payload bytes produced per wire codec, "
+                   "labeled codec=fp16|bf16|int8|onebit|topk<K> — the "
+                   "per-codec split of wire_compressed_bytes_total"),
+    "wire_ef_residual_bytes": (
+        "gauge", "bytes held in error-feedback residual accumulators "
+                 "(lossy wire codecs; grows once per distinct "
+                 "tensor-set/segment shape, then stays flat)"),
+    "wire_ef_flush_seconds_total": (
+        "counter", "seconds spent folding error-feedback residuals into "
+                   "segments and computing the new residual after each "
+                   "lossy encode"),
     "aborts_total": (
         "counter", "coordinated aborts, labeled dir=sent|received"),
     # -- transport selection (transport/select.py, transport/shm.py) --

@@ -803,6 +803,10 @@ class FrameBitRegistry(Rule):
         "_LEN", "_CRC", "_CTRL_FLAG", "_DEFER_FLAG", "_DIGEST_FLAG",
         "_WIRE_DTYPE_SHIFT", "_WIRE_DTYPE_MASK", "_FLAGS_MASK",
         "_DIGEST_PAYLOAD", "_FrameHeader", "_MAX_FRAME_BYTES",
+        # wire dtype codes (the 3-bit lane's values): re-binding one
+        # outside the registry forks the compression skew contract
+        "_WIRE_DTYPE_RAW", "_WIRE_DTYPE_FP16", "_WIRE_DTYPE_BF16",
+        "_WIRE_DTYPE_INT8", "_WIRE_DTYPE_ONEBIT", "_WIRE_DTYPE_TOPK",
     })
     _FLAG_BIT_RANGE = range(56, 64)
 

@@ -54,6 +54,18 @@ _DEFER_FLAG = 1 << 62
 _DIGEST_FLAG = 1 << 61
 _WIRE_DTYPE_SHIFT = 56
 _WIRE_DTYPE_MASK = 0x7 << _WIRE_DTYPE_SHIFT
+# Wire dtype codes carried in the 3-bit lane (bits 56-58).  The codes ARE
+# the compression-config skew detector: a peer whose
+# HOROVOD_WIRE_COMPRESSION disagrees stamps a different code and the
+# receiver poisons the stream instead of mis-decoding bytes.  Codes are
+# registered HERE and only here (HVD008); ``backend/compression.py``
+# imports them.  Renumbering any of these is a wire protocol break.
+_WIRE_DTYPE_RAW = 0      # uncompressed work-dtype bytes
+_WIRE_DTYPE_FP16 = 1     # cast-on-the-wire float16
+_WIRE_DTYPE_BF16 = 2     # cast-on-the-wire bfloat16
+_WIRE_DTYPE_INT8 = 3     # <f4 scale> + symmetric int8 quantization
+_WIRE_DTYPE_ONEBIT = 4   # <f4 pos><f4 neg> means + packed sign bits
+_WIRE_DTYPE_TOPK = 5     # <u4 index><work-dtype value> pairs (top-k)
 # All header flag bits — everything that is not payload length.
 _FLAGS_MASK = _CTRL_FLAG | _DEFER_FLAG | _DIGEST_FLAG | _WIRE_DTYPE_MASK
 # Digest-check frame payload: digest algorithm code, 64-bit chained

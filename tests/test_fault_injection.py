@@ -356,6 +356,39 @@ def test_truncate_compressed_frame_np2_coordinated_abort():
 
 
 @pytest.mark.timeout(150)
+def test_corrupt_int8_frame_np2_coordinated_abort():
+    """The lossy codecs ride the same integrity plane: a byte flip on an
+    int8-quantized (digest-deferred) byte blob is caught by the step
+    digest and aborts both ranks with the wire-CRC diagnosis."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_WIRE_COMPRESSION": "int8",
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=6:action=corrupt,1"})
+    assert "SURVIVOR_ABORT 0" in outs[0], outs[0]
+    assert "wire CRC" in outs[0], outs[0]
+    assert "SURVIVOR_ABORT 1" in outs[1], outs[1]
+
+
+@pytest.mark.timeout(150)
+def test_truncate_topk_frame_np2_coordinated_abort():
+    """A truncated variable-length topk frame misframes the stream; the
+    exact-size contract (sizes derived from wire_nbytes on both ends, not
+    from the bytes) converts it into a coordinated abort — never a hang
+    or a struct.error."""
+    outs = run_distributed(
+        2, _SURVIVOR_BODY, timeout=120, expect_failure=True, retries=0,
+        extra_env={**_FAST_DEADLINE,
+                   "HOROVOD_WIRE_COMPRESSION": "topk10",
+                   "HOROVOD_FAULT_SPEC":
+                       "tcp.send:rank=1:nth=6:action=truncate,4"})
+    for r in range(2):
+        assert f"SURVIVOR_ABORT {r}" in outs[r], (r, outs[r])
+        assert "struct.error" not in outs[r], (r, outs[r])
+
+
+@pytest.mark.timeout(150)
 def test_truncated_frame_np2_typed_abort():
     """A misframed (short) application frame passes the wire CRC by
     construction and must be caught by the defensive parse layer as a
@@ -565,12 +598,18 @@ hvd.shutdown()
 """
 
 
-def _run_elastic_corruption_job(tmp_path, fault_spec, extra_env=None):
+_ELASTIC_INT8_TRAIN = _ELASTIC_CORRUPTION_TRAIN.replace(
+    "np.full(4, float(state.batch + 1), np.float32)",
+    "np.full(4, 127.0 * float(state.batch + 1), np.float32)")
+
+
+def _run_elastic_corruption_job(tmp_path, fault_spec, extra_env=None,
+                                train_src=_ELASTIC_CORRUPTION_TRAIN):
     disc = tmp_path / "discover.sh"
     disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
     disc.chmod(0o755)
     train = tmp_path / f"train_{'fault' if fault_spec else 'clean'}.py"
-    train.write_text(_ELASTIC_CORRUPTION_TRAIN)
+    train.write_text(train_src)
 
     env = os.environ.copy()
     env.update(_FAST_DEADLINE)
@@ -625,6 +664,25 @@ def test_elastic_recovers_from_corruption_with_compression_on(tmp_path):
     faulted, proc = _run_elastic_corruption_job(
         tmp_path, "tcp.send:rank=1:nth=25:action=corrupt,1",
         extra_env=comp_env)
+    assert faulted == clean, "recovery did not converge to the no-fault run"
+    assert "wire CRC" in proc.stderr, proc.stderr[-3000:]
+
+
+@pytest.mark.timeout(600)
+def test_elastic_recovers_with_int8_compression_bit_identical(tmp_path):
+    """Lossy compression composes with elastic recovery: int8 + error
+    feedback + an in-flight byte flip.  The gradients are crafted so the
+    int8 round trip is EXACT (magnitudes 127·(batch+1) → scale divides
+    out, residuals stay zero), so dropping the EF accumulators at
+    re-init — which recovery must do, state is op-owned — leaves the
+    faulted run BIT-identical to a no-fault run."""
+    comp_env = {"HOROVOD_WIRE_COMPRESSION": "int8"}
+    clean, _ = _run_elastic_corruption_job(
+        tmp_path, None, extra_env=comp_env,
+        train_src=_ELASTIC_INT8_TRAIN)
+    faulted, proc = _run_elastic_corruption_job(
+        tmp_path, "tcp.send:rank=1:nth=25:action=corrupt,1",
+        extra_env=comp_env, train_src=_ELASTIC_INT8_TRAIN)
     assert faulted == clean, "recovery did not converge to the no-fault run"
     assert "wire CRC" in proc.stderr, proc.stderr[-3000:]
 

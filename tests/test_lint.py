@@ -713,6 +713,19 @@ HVD008_REBIND = """
     _CTRL_FLAG = 1 << 40
 """
 
+HVD008_WIRE_CODE_REBIND = """
+    _WIRE_DTYPE_INT8 = 3
+"""
+
+HVD008_WIRE_CODE_CLEAN = """
+    from horovod_tpu.transport.frame_bits import (_WIRE_DTYPE_INT8,
+                                                  _WIRE_DTYPE_ONEBIT,
+                                                  _WIRE_DTYPE_TOPK)
+    def codec_code(name):
+        return {"int8": _WIRE_DTYPE_INT8, "onebit": _WIRE_DTYPE_ONEBIT,
+                "topk": _WIRE_DTYPE_TOPK}[name]
+"""
+
 HVD008_CLEAN = """
     from horovod_tpu.transport.frame_bits import _CTRL_FLAG, _FLAGS_MASK
     def is_ctrl(word):
@@ -745,6 +758,19 @@ def test_hvd008_registry_name_rebind():
     vs = run(HVD008_REBIND)
     assert codes(vs) == ["HVD008"]
     assert "_CTRL_FLAG" in vs[0].message
+
+
+def test_hvd008_wire_dtype_code_rebind():
+    # Re-defining a wire-dtype CODE outside frame_bits.py forks the
+    # compression skew contract — two peers could stamp the same lane
+    # value for different codecs and mis-decode instead of aborting.
+    vs = run(HVD008_WIRE_CODE_REBIND)
+    assert codes(vs) == ["HVD008"]
+    assert "_WIRE_DTYPE_INT8" in vs[0].message
+
+
+def test_hvd008_wire_dtype_code_import_is_clean():
+    assert run(HVD008_WIRE_CODE_CLEAN) == []
 
 
 def test_hvd008_clean():
