@@ -65,4 +65,21 @@ print(f"np=128 churn: {rec['final_epoch']} epochs, "
 EOF
 cat ci/chaos.largemesh.log
 [ "$rc" -eq 0 ] || { echo "large-mesh lane FAILED (rc=$rc)"; exit "$rc"; }
+
+# Self-healing demotion lane (docs/elastic.md "Self-healing demotion").
+# The live np=3 chronic-straggler scenario (host shed, cause=demotion,
+# bit-identical convergence, HOROVOD_LOCK_DEBUG=1 below) already ran in
+# the pytest chaos lane above via the module's chaos mark; this lane adds
+# the np=128 scale proof — the artifact-generating slow test drives 3
+# demotion reports through the real driver over the shaped wire, regrows
+# benchmarks/results/sim_demotion_np128.json, and asserts the committed
+# artifact's digest reproduces from a fresh same-seed cluster (the
+# non-fabrication witness), with zero lock-inversion cycles.
+echo "demotion lane: np=128 simulated demotions under HOROVOD_LOCK_DEBUG=1"
+rc=0
+JAX_PLATFORMS=cpu HOROVOD_LOCK_DEBUG=1 \
+python -m pytest "tests/test_sim_cluster.py::test_sim_demotion_np128_artifact" \
+    -m slow -v -p no:cacheprovider > ci/chaos.demotion.log 2>&1 || rc=$?
+cat ci/chaos.demotion.log
+[ "$rc" -eq 0 ] || { echo "demotion lane FAILED (rc=$rc)"; exit "$rc"; }
 echo "chaos lane PASSED"

@@ -236,6 +236,16 @@ HOROVOD_STRAGGLER_THRESHOLD_SECS = "HOROVOD_STRAGGLER_THRESHOLD_SECS"
 # EWMA smoothing factor in (0, 1] for the per-rank readiness lag: higher
 # reacts faster, lower rides out one-cycle noise.
 HOROVOD_STRAGGLER_EWMA_ALPHA = "HOROVOD_STRAGGLER_EWMA_ALPHA"
+# Chronic-straggler demotion (docs/elastic.md "self-healing demotion"):
+# a rank whose lag EWMA stays above this many seconds for
+# HOROVOD_STRAGGLER_DEMOTE_CYCLES consecutive busy cycles is reported to
+# the elastic driver, which blacklists its host and advances the epoch.
+# 0 (the default) disables demotion entirely — flagging alone never
+# sheds capacity.
+HOROVOD_STRAGGLER_DEMOTE_SECS = "HOROVOD_STRAGGLER_DEMOTE_SECS"
+# Consecutive busy cycles the EWMA must stay over the demote threshold
+# before the verdict fires (the hysteresis window; >= 1).
+HOROVOD_STRAGGLER_DEMOTE_CYCLES = "HOROVOD_STRAGGLER_DEMOTE_CYCLES"
 # Per-tensor lifecycle spans in the timeline ("1"/"0", default on):
 # submitted → negotiated → fused → wire → reduced → callback spans on
 # every rank.  Only consulted when a timeline is active; costs one
@@ -335,6 +345,14 @@ DEFAULT_STRAGGLER_THRESHOLD_SECS = 5.0
 # 0.25: a sustained lag reaches ~90% of its value within 8 lagging
 # cycles, while a single slow cycle decays below threshold immediately.
 DEFAULT_STRAGGLER_EWMA_ALPHA = 0.25
+# Demotion is opt-in: shedding capacity on a heuristic is a policy
+# decision the operator must make explicitly, so the default threshold
+# disables it (flagging/metrics still run).
+DEFAULT_STRAGGLER_DEMOTE_SECS = 0.0
+# 10 consecutive over-threshold busy cycles: with the default alpha a
+# one-shot delay decays under threshold within a cycle or two, so only a
+# persistently slow rank can hold a 10-cycle streak.
+DEFAULT_STRAGGLER_DEMOTE_CYCLES = 10
 # 512 ops between compactions: elastic churn writes ~2N keys per epoch,
 # so replay stays bounded at a few epochs' worth of ops even at np=64
 # while steady-state lease renewals don't compact every few seconds.

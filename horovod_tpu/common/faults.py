@@ -11,7 +11,7 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, clauses joined by ``;``)::
 
     clause  := site[:key=value]...
     site    := tcp.send | tcp.recv | shm.send | shm.recv |
-               controller.negotiate |
+               controller.negotiate | controller.tally |
                enqueue.collective | dispatch.collective |
                rendezvous.get | worker.spawn |
                ckpt.save | store.put | store.get_serve | driver.tick
@@ -76,6 +76,7 @@ SITES = (
     "shm.send",
     "shm.recv",
     "controller.negotiate",
+    "controller.tally",
     "enqueue.collective",
     "dispatch.collective",
     "rendezvous.get",
@@ -287,6 +288,37 @@ def inject(site: str, rank: Optional[int] = None,
     if drop:
         return True  # drop wins over a concurrent mutation
     return mutation if mutation is not None else False
+
+
+def inject_deferred(site: str, rank: Optional[int] = None) -> float:
+    """Like :func:`inject`, but ``delay_ms`` clauses return their delay in
+    SECONDS instead of sleeping.
+
+    Built for sites inside a synchronous lockstep loop — the coordinator's
+    tally path (``controller.tally``) — where a ``time.sleep`` would slow
+    every rank equally and attribute lag to nobody.  The caller turns the
+    returned delay into *deferred work* (the tally is parked and replayed
+    after the delay matures), so the injected slowness lands on exactly the
+    matched rank while the rest of the world keeps cycling.  Clauses with
+    any other action delegate to the normal action runner (raise / exit /
+    hang keep their usual semantics).  Returns 0.0 when no delay clause
+    fired.
+    """
+    if rank is None:
+        rank = _default_rank()
+    fire: List[_Clause] = []
+    with _lock:
+        for clause in _clauses:
+            if clause.matches(site, rank, None) and clause.should_fire():
+                fire.append(clause)
+    delay = 0.0
+    for clause in fire:
+        _record_fire(clause, site, rank)
+        if clause.action == "delay_ms":
+            delay = max(delay, float(clause.action_arg or "100") / 1000.0)
+        else:
+            _run_action(clause, site, rank)
+    return delay
 
 
 def _record_fire(clause: _Clause, site: str, rank: int) -> None:

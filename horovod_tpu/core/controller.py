@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..common import faults
 from ..common.logging_util import get_logger
@@ -117,6 +117,76 @@ class _TableEntry:
     # ranks' lag from here, not from first_seen, so one early rank cannot
     # smear everyone else as "behind".
     majority_seen: Optional[float] = None
+
+
+class DemotionPolicy:
+    """Chronic-straggler verdict state machine (pure; no I/O, no clocks).
+
+    Promotes the per-cycle straggler *flag* to a demotion *verdict*: a
+    rank whose readiness-lag EWMA stays above ``demote_secs`` for
+    ``demote_cycles`` consecutive busy cycles is chronically slow and
+    worth shedding.  Three safety properties are built in:
+
+    - **Hysteresis window**: one streak counter per rank, reset the
+      moment its EWMA dips back under the threshold — a transient stall
+      can never accumulate a verdict across gaps.
+    - **Whole-world-slow guard**: when half or more of the active ranks
+      are over threshold, the mesh is globally stalled (GC pause, shared
+      NFS hiccup, coordinator overload) and *nobody* is demoted; all
+      streaks reset so the stall doesn't seed later verdicts.  At
+      np <= 2 one slow rank IS half the world, so demotion needs at
+      least 3 active ranks — by construction, not by special case.
+    - **One demotion per epoch**: a misconfigured threshold demotes at
+      most one host before the epoch advances and the world is
+      re-evaluated; it cannot cascade the fleet to zero.
+
+    Fed by ``Controller._update_stragglers`` on busy cycles only (idle
+    cycles stamp no majorities, so "consecutive cycles" means cycles
+    that actually measured lag).  ``docs/elastic.md`` has the diagram.
+    """
+
+    def __init__(self, demote_secs: float, demote_cycles: int):
+        if demote_cycles < 1:
+            raise ValueError(
+                f"HOROVOD_STRAGGLER_DEMOTE_CYCLES={demote_cycles!r}: "
+                "expected >= 1")
+        self.demote_secs = demote_secs
+        self.demote_cycles = demote_cycles
+        self._streak: Dict[int, int] = {}
+        self._demoted_epochs: Set[int] = set()
+
+    @property
+    def enabled(self) -> bool:
+        return self.demote_secs > 0.0
+
+    def observe(self, epoch: int, ewma: Dict[int, float],
+                active: Set[int]) -> Optional[int]:
+        """One busy cycle's EWMA snapshot → the rank to demote, or None.
+
+        Marks the epoch demoted when it returns a victim; callers own
+        delivering the verdict (the coordinator posts it to the driver).
+        """
+        if not self.enabled or not active:
+            return None
+        over = {r for r in active if ewma.get(r, 0.0) > self.demote_secs}
+        if not over or 2 * len(over) >= len(active):
+            # Nothing chronic, or the whole world is slow — either way no
+            # rank is individually at fault this cycle.
+            self._streak.clear()
+            return None
+        for r in [r for r in self._streak if r not in over]:
+            del self._streak[r]
+        for r in over:
+            self._streak[r] = self._streak.get(r, 0) + 1
+        if epoch in self._demoted_epochs:
+            return None
+        chronic = [r for r in over if self._streak[r] >= self.demote_cycles]
+        if not chronic:
+            return None
+        victim = max(chronic, key=lambda r: ewma.get(r, 0.0))
+        self._demoted_epochs.add(epoch)
+        self._streak.pop(victim, None)
+        return victim
 
 
 class Controller:
@@ -222,6 +292,27 @@ class Controller:
         # False while every EWMA sits at zero and nothing lags: the
         # per-cycle update early-outs to two dict checks in steady state.
         self._straggler_decaying = False
+        # A fresh controller is a fresh world (elastic epoch restart in
+        # the same process): the process-global suspect gauge must not
+        # keep naming a suspect from the previous world's EWMA map.
+        # Only a stale non-cleared gauge is reset — a clean start leaves
+        # the registry untouched (steady state stays metrics-silent).
+        if topology.rank == 0 and metrics.registry.get_gauge(
+                "straggler_suspect") not in (None, -1):
+            self._set_suspect_gauge()
+        # Chronic-straggler demotion (docs/elastic.md "self-healing
+        # demotion"): verdict state machine fed by the EWMAs above;
+        # disabled unless HOROVOD_STRAGGLER_DEMOTE_SECS > 0.
+        self.demotion = DemotionPolicy(
+            env_mod.get_float(env_mod.HOROVOD_STRAGGLER_DEMOTE_SECS,
+                              env_mod.DEFAULT_STRAGGLER_DEMOTE_SECS),
+            env_mod.get_int(env_mod.HOROVOD_STRAGGLER_DEMOTE_CYCLES,
+                            env_mod.DEFAULT_STRAGGLER_DEMOTE_CYCLES))
+        # Tallies parked by a ``controller.tally`` delay_ms injection:
+        # (maturity monotonic time, Request), replayed by
+        # _mature_deferred_tallies once mature — the injected slowness
+        # lands on one rank's tallies while the cycle keeps turning.
+        self._deferred_tallies: List[Tuple[float, Request]] = []
 
     # ------------------------------------------------------------------
     # the per-cycle negotiation round
@@ -384,6 +475,7 @@ class Controller:
             for req in rl.requests:
                 if self._increment(req):
                     ready.append(req.tensor_name)
+        ready.extend(self._mature_deferred_tallies())
 
         # A JOIN that lands after a tensor's last active-rank request must
         # still complete that tensor: re-check pending entries against the
@@ -686,12 +778,30 @@ class Controller:
     # message table
     # ------------------------------------------------------------------
 
-    def _increment(self, req: Request) -> bool:
+    def _increment(self, req: Request, defer_faults: bool = True) -> bool:
         """Tally one rank's readiness; True when the tensor is globally ready.
 
         Reference ``IncrementTensorCount`` (``controller.cc:1030-1053``):
         completion when (requesting ranks) + (joined ranks) covers the world.
+
+        ``controller.tally`` fault site: a matching ``delay_ms`` clause
+        parks this tally on ``_deferred_tallies`` instead of sleeping —
+        sleeping here would slow the whole lockstep cycle equally and
+        attribute lag to nobody, while a parked tally leaves the tensor
+        incomplete *missing exactly this rank* across cycles, which is
+        what a chronically slow rank looks like to the straggler EWMAs.
+        Replayed tallies pass ``defer_faults=False`` so an ``after=``
+        clause cannot re-defer them forever.  Only the request-table path
+        is injectable: cache-bit announcements never reach this tally.
         """
+        if faults.ACTIVE and defer_faults and self.topo.size > 1 \
+                and req.request_type != RequestType.JOIN:
+            delay = faults.inject_deferred("controller.tally",
+                                           rank=req.request_rank)
+            if delay > 0.0:
+                self._deferred_tallies.append(
+                    (time.monotonic() + delay, req))
+                return False
         if req.request_type == RequestType.JOIN:
             self._joined_ranks.add(req.request_rank)
             # Join completes when *every* rank has joined.
@@ -716,6 +826,24 @@ class Controller:
                 2 * len(entry.ranks) >= self.topo.size - len(self._joined_ranks):
             entry.majority_seen = time.monotonic()
         return len(entry.ranks) >= needed
+
+    def _mature_deferred_tallies(self) -> List[str]:
+        """Replay parked tallies whose injected delay has matured; returns
+        tensors the replays completed (merged into the cycle's ready list).
+        Empty-list fast path when nothing is parked (the normal case)."""
+        if not self._deferred_tallies:
+            return []
+        now = time.monotonic()
+        completed: List[str] = []
+        parked: List[Tuple[float, Request]] = []
+        for due, req in self._deferred_tallies:
+            if due <= now:
+                if self._increment(req, defer_faults=False):
+                    completed.append(req.tensor_name)
+            else:
+                parked.append((due, req))
+        self._deferred_tallies = parked
+        return completed
 
     # ------------------------------------------------------------------
     # response construction & validation
@@ -995,6 +1123,42 @@ class Controller:
                          "back to %.3fs", r, v)
                 self._set_suspect_gauge()
         self._straggler_decaying = decaying or bool(self._straggler_suspects)
+        if self.demotion.enabled:
+            from ..common import env as env_mod
+
+            victim = self.demotion.observe(env_mod.get_epoch(), ewma, active)
+            if victim is not None:
+                self._report_demotion(victim, ewma.get(victim, 0.0))
+
+    def _report_demotion(self, victim: int, lag_ewma: float) -> None:
+        """Deliver a chronic-straggler verdict: flight-recorder event +
+        log line on the coordinator, and a best-effort demotion report to
+        the elastic driver over the rendezvous store.  Outside an elastic
+        job (no store in the environment) the verdict is detector-only —
+        named loudly, acted on by nobody."""
+        flight_recorder.record(
+            "straggler_demotion", rank=victim, lag_ewma=round(lag_ewma, 6),
+            threshold=self.demotion.demote_secs,
+            cycles=self.demotion.demote_cycles)
+        log.warning(
+            "chronic straggler: rank %d readiness-lag EWMA %.3fs stayed "
+            "over HOROVOD_STRAGGLER_DEMOTE_SECS=%.3fs for %d consecutive "
+            "busy cycles — reporting for demotion", victim, lag_ewma,
+            self.demotion.demote_secs, self.demotion.demote_cycles)
+        try:
+            from ..elastic import rendezvous_client
+
+            posted = rendezvous_client.post_demotion_report(
+                victim, lag_ewma, self.demotion.demote_secs,
+                self.demotion.demote_cycles)
+        except Exception as exc:  # noqa: BLE001 — a demotion report must
+            # never take down the negotiation cycle it rode along with
+            posted = False
+            log.warning("demotion report for rank %d failed: %s",
+                        victim, exc)
+        if not posted:
+            log.warning("no rendezvous store reachable: demotion verdict "
+                        "for rank %d is detector-only", victim)
 
     def _set_suspect_gauge(self) -> None:
         worst = max(self._straggler_suspects,

@@ -103,6 +103,16 @@ CATALOG: Dict[str, Tuple[str, str]] = {
         "counter", "straggler flag transitions — a rank's readiness-lag "
                    "EWMA crossing the threshold — labeled rank= "
                    "(coordinator only)"),
+    "straggler_demotions_total": (
+        "counter", "chronic-straggler demotions the elastic driver acted "
+                   "on (host blacklisted + epoch advanced), labeled "
+                   "rank=/host= (driver only; docs/elastic.md "
+                   "self-healing demotion)"),
+    "demotion_latency_seconds": (
+        "histogram", "coordinator verdict posted -> driver blacklist "
+                     "applied, wall-clock across processes (driver only; "
+                     "the sim lane measures the full flag->first-step "
+                     "curve on one clock)"),
     # -- rendezvous / elastic --
     "rendezvous_store_ops_total": (
         "counter", "HTTP KV store requests, labeled op=get|set|delete|keys"),
@@ -153,7 +163,7 @@ CATALOG: Dict[str, Tuple[str, str]] = {
                  "(sim harness only)"),
     "sim_churn_events_total": (
         "counter", "churn events the simulated cluster injected, labeled "
-                   "kind=lease_expiry|reset_request|worker_exit"),
+                   "kind=lease_expiry|reset_request|worker_exit|demotion"),
     "sim_wire_delay_seconds_total": (
         "counter", "artificial shaped-wire delay the sim injected across "
                    "all links (latency + bandwidth + jitter terms)"),
@@ -185,9 +195,9 @@ CATALOG: Dict[str, Tuple[str, str]] = {
                      "+ host discovery + any epoch transition it caused)"),
     "driver_epoch_transitions_total": (
         "counter", "elastic driver epoch advances, labeled cause="
-                   "lease_expiry|reset_request|worker_exit|host_change "
-                   "(driver only; the flight recorder carries the same "
-                   "cause tag per event)"),
+                   "lease_expiry|demotion|reset_request|worker_exit|"
+                   "host_change (driver only; the flight recorder "
+                   "carries the same cause tag per event)"),
     # -- integrity / failure plane --
     "crc_verify_seconds_total": (
         "counter", "seconds spent computing/verifying wire CRC32 "

@@ -67,8 +67,12 @@ class HostManager:
     preemptible TPU-VM fleet a host is usually "bad" only transiently —
     preempted, rebooting, migrating — and a permanent blacklist shrinks
     the pool monotonically until the job starves below min_np.  After the
-    cooldown the host rejoins the candidate pool; if it fails again it is
-    simply re-blacklisted (each strike restarts the clock)."""
+    cooldown the host rejoins the candidate pool; if it fails again a
+    FRESH strike restarts the clock.  Strikes are idempotent while
+    active: re-blacklisting an already-listed host (repeated demotion
+    reports within one epoch, a crash racing a demotion) keeps the
+    original expiry, so strikes never stack into a de-facto permanent
+    ban."""
 
     def __init__(self, discovery: HostDiscovery,
                  blacklist_cooldown: Optional[float] = None):
@@ -86,16 +90,28 @@ class HostManager:
     def _now() -> float:
         return time.monotonic()
 
-    def blacklist(self, hostname: str) -> None:
+    def blacklist(self, hostname: str,
+                  evidence: Optional[str] = None) -> bool:
+        """Blacklist ``hostname``; True on a NEW strike, False when the
+        host was already listed (the existing expiry is kept — see the
+        class docstring).  ``evidence`` (e.g. the straggler EWMA behind a
+        demotion) is logged with the strike so the driver log and the
+        flight recorder agree on *why* the host was shed."""
         expiry = self._now() + self._cooldown \
             if self._cooldown > 0 else float("inf")
         with self._lock:
-            if hostname not in self._blacklist:
-                log.warning(
-                    "blacklisting host %s%s", hostname,
-                    f" for {self._cooldown:g}s" if self._cooldown > 0
-                    else " permanently")
+            self._expire_blacklist_locked()
+            if hostname in self._blacklist:
+                log.debug("host %s already blacklisted; strike not stacked",
+                          hostname)
+                return False
+            log.warning(
+                "blacklisting host %s%s%s", hostname,
+                f" for {self._cooldown:g}s" if self._cooldown > 0
+                else " permanently",
+                f" (evidence: {evidence})" if evidence else "")
             self._blacklist[hostname] = expiry
+            return True
 
     def _expire_blacklist_locked(self) -> None:
         now = self._now()

@@ -106,6 +106,11 @@ class ElasticDriver:
         self._await_ack: Optional[bool] = None  # added_only flavor, or None
         self._removed_identities: set = set()
         self._exited_identities: set = set()
+        # (reporter identity, epoch, rank) demotions already counted: a
+        # current-epoch report stays readable in the store until the
+        # epoch advances (e.g. across waiting-for-capacity ticks), and
+        # re-reading it must not re-count metrics or re-log the shed.
+        self._demotion_seen: Set[Tuple[str, int, int]] = set()
         # Once any worker succeeds the job is winding down: membership no
         # longer changes, so a finished (dead-but-successful) identity can
         # never be handed a rank in a fresh epoch (reference
@@ -306,12 +311,17 @@ class ElasticDriver:
             fetched = self._tick_store_reads()
             self._renotify_unacked(fetched.get("epoch_ack"))
             reset_reasons = self._pending_reset_requests(fetched["reset"])
+            demotion_reports = self._parse_demotion_reports(
+                fetched["demotion"], self.epoch)
             expired = self._scan_leases(fetched["lease"])
             self._store_recovered()
             self._push_driver_metrics()
         except self._STORE_ERRORS as e:
             self._store_outage(e)
             return
+        # Demotions blacklist BEFORE the discovery poll so the shed host
+        # drops out of this very tick's host set (changed + removal).
+        demoted = self._apply_demotions(demotion_reports)
         try:
             changed, removal = self.hosts.update_available_hosts()
         except Exception as e:  # noqa: BLE001 — discovery script hiccups
@@ -342,7 +352,8 @@ class ElasticDriver:
             missing_workers = {
                 f"{s.hostname}:{s.local_rank}" for s in self._slots
             } - set(self._known_identities)
-        if not changed and not missing_workers and not reset_reasons:
+        if not changed and not missing_workers and not reset_reasons \
+                and not demoted:
             return
         if self.reset_limit is not None and \
                 self.resets >= self.reset_limit:
@@ -359,17 +370,21 @@ class ElasticDriver:
         # process still alive) is removal-LIKE for sync purposes: the
         # workers rolled back and must state.sync() after the reset.
         removalish = removal or bool(missing_workers) \
-            or bool(reset_reasons)
+            or bool(reset_reasons) or bool(demoted)
         # Cause precedence mirrors the judgment order above: an expired
-        # lease explains the missing worker it produced, a reset request
-        # means everyone is alive, worker_exit is a death the exit
-        # monitor saw first, host_change is pure discovery movement.
+        # lease explains the missing worker it produced, a demotion is a
+        # deliberate shed of a live-but-slow host, a reset request means
+        # everyone is alive, worker_exit is a death the exit monitor saw
+        # first, host_change is pure discovery movement.
         cause = ("lease_expiry" if expired else
+                 "demotion" if demoted else
                  "reset_request" if reset_reasons else
                  "worker_exit" if missing_workers else "host_change")
         log.info("host set changed (removal=%s, dead_workers=%s, "
-                 "reset_requests=%s); advancing epoch",
-                 removal, sorted(missing_workers), reset_reasons)
+                 "reset_requests=%s, demotions=%s, cause=%s); "
+                 "advancing epoch",
+                 removal, sorted(missing_workers), reset_reasons, demoted,
+                 cause)
         self._rendezvous_epoch()
         self._await_ack = not removalish  # remember flavor for re-notify
         self._notify_workers(added_only=not removalish)
@@ -377,7 +392,7 @@ class ElasticDriver:
         flight_recorder.record(
             "epoch_transition", epoch=self.epoch, cause=cause,
             removal=removal, dead_workers=sorted(missing_workers),
-            reset_requests=reset_reasons)
+            reset_requests=reset_reasons, demotions=demoted)
         if timeline_mod.control_active():
             timeline_mod.control_span_since(
                 "driver", "CHURN_EVENT", t0_ns,
@@ -409,6 +424,8 @@ class ElasticDriver:
             ops.extend(("get", "epoch_ack", i) for i in ack_ids)
         ops.extend(("get", rendezvous_client.RESET_REQUEST_SCOPE, i)
                    for i in slot_ids)
+        ops.extend(("get", rendezvous_client.DEMOTION_REPORT_SCOPE, i)
+                   for i in slot_ids)
         ops.extend(("get", LEASE_SCOPE, i) for i in slot_ids)
         results = self.rendezvous.batch(ops)
         idx = 0
@@ -418,6 +435,9 @@ class ElasticDriver:
                 zip(ack_ids, results[idx:idx + len(ack_ids)]))
             idx += len(ack_ids)
         out["reset"] = dict(zip(slot_ids, results[idx:idx + len(slot_ids)]))
+        idx += len(slot_ids)
+        out["demotion"] = dict(
+            zip(slot_ids, results[idx:idx + len(slot_ids)]))
         idx += len(slot_ids)
         out["lease"] = dict(zip(slot_ids, results[idx:]))
         return out
@@ -469,6 +489,79 @@ class ElasticDriver:
                 reasons.append(
                     f"{identity}: {req.get('reason', 'unspecified')}")
         return reasons
+
+    @staticmethod
+    def _parse_demotion_reports(
+            raws: Optional[Dict[str, object]],
+            epoch: int) -> List[Dict[str, object]]:
+        """Coordinator-posted demotion reports for the CURRENT epoch.
+
+        Mirrors the reset-request staleness rule: a report stamped with
+        an older epoch was answered by a later bump already (the epoch
+        advance it caused re-evaluated the whole world) and is ignored —
+        stale reports auto-expire, no deletion round-trip needed.
+        Malformed payloads are skipped; this channel is advisory."""
+        reports: List[Dict[str, object]] = []
+        for identity in sorted(raws or {}):
+            raw = raws[identity]
+            if raw is None:
+                continue
+            try:
+                rep = json.loads(bytes(raw).decode())
+            except (ValueError, TypeError):
+                continue
+            if isinstance(rep, dict) and rep.get("epoch", -1) == epoch \
+                    and isinstance(rep.get("rank"), int):
+                rep["reporter"] = identity
+                reports.append(rep)
+        return reports
+
+    def _apply_demotions(
+            self, reports: List[Dict[str, object]]) -> List[str]:
+        """Blacklist the hosts named by current-epoch demotion reports.
+
+        The victim's hostname is resolved authoritatively from the
+        driver's own slot table by rank (the report's hostname field is
+        best-effort evidence).  Returns ``rank@host`` strings for the
+        demotions applied this tick — they drive the epoch advance and
+        its ``cause="demotion"`` trail.  Repeated reports for a host
+        already blacklisted still count as a demotion in flight (the
+        epoch must advance) but stack no cooldown strike
+        (``HostManager.blacklist`` idempotency)."""
+        applied: List[str] = []
+        for rep in reports:
+            rank = rep["rank"]
+            with self._lock:
+                host = next((s.hostname for s in self._slots
+                             if s.rank == rank), None)
+            host = host or rep.get("hostname")
+            if not isinstance(host, str) or not host:
+                log.warning("demotion report for rank %s names no "
+                            "resolvable host; ignoring", rank)
+                continue
+            evidence = (f"rank {rank} readiness-lag EWMA {rep.get('ewma')}s "
+                        f"over demote threshold {rep.get('threshold')}s for "
+                        f"{rep.get('cycles')} consecutive busy cycles")
+            new_strike = self.hosts.blacklist(host, evidence=evidence)
+            key = (str(rep.get("reporter")), self.epoch, rank)
+            if key not in self._demotion_seen:
+                self._demotion_seen.add(key)
+                metrics.inc("straggler_demotions_total",
+                            rank=str(rank), host=host)
+                posted = rep.get("posted_unix")
+                if isinstance(posted, (int, float)):
+                    # Wall-clock across processes (coordinator vs
+                    # driver): same-host skew is negligible against the
+                    # multi-tick latencies this histogram bounds.
+                    metrics.observe("demotion_latency_seconds",
+                                    max(0.0, time.time() - posted))
+                flight_recorder.record(
+                    "demotion", epoch=self.epoch, rank=rank, host=host,
+                    ewma=rep.get("ewma"), new_strike=new_strike,
+                    reporter=rep.get("reporter"))
+                log.warning("demoting host %s: %s", host, evidence)
+            applied.append(f"rank {rank}@{host}")
+        return applied
 
     # -- lease liveness / store outage (docs/control_plane.md) ---------
 

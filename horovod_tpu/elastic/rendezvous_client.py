@@ -23,6 +23,14 @@ RANK_AND_SIZE_SCOPE = "rank_and_size"
 #: epoch (see ``request_reset``).  The driver treats a CURRENT-epoch
 #: request like a membership change: advance, publish, notify.
 RESET_REQUEST_SCOPE = "reset_request"
+#: Coordinator → driver demotion channel: the straggler plane's verdict
+#: (``core/controller.py`` DemotionPolicy) posts ``{"epoch": N,
+#: "rank": R, "hostname": ..., "ewma": ..., ...}`` here
+#: (see ``post_demotion_report``).  Like reset requests, the driver
+#: honors a CURRENT-epoch report only — a stale report was answered by a
+#: later epoch bump already — and blacklists the named host before
+#: advancing the epoch (docs/elastic.md "self-healing demotion").
+DEMOTION_REPORT_SCOPE = "demotion_report"
 
 
 def _identity() -> str:
@@ -74,6 +82,59 @@ def request_reset(reason: str) -> bool:
         # slow path (reinit timeout → transient exit → respawn) if the
         # store is unreachable; failing the fast path must not mask the
         # original error being recovered from.
+        return False
+
+
+def _resolve_hostname(store: HTTPStoreClient, rank: int) -> Optional[str]:
+    """Best-effort reverse lookup rank → hostname from the driver's
+    published slot table (identities are ``hostname:local_rank`` keys).
+    The driver re-resolves authoritatively from its own slot table; this
+    only makes the report's evidence human-readable."""
+    try:
+        keys = store.keys(RANK_AND_SIZE_SCOPE)
+        if not keys:
+            return None
+        vals = store.batch([("get", RANK_AND_SIZE_SCOPE, k) for k in keys])
+        epoch = env_mod.get_epoch()
+        for key, raw in zip(keys, vals):
+            if raw is None:
+                continue
+            slot = json.loads(bytes(raw).decode())
+            if slot.get("rank") == rank and slot.get("epoch", 0) == epoch:
+                return key.rsplit(":", 1)[0]
+    except Exception:  # noqa: BLE001 — evidence only, never load-bearing
+        pass
+    return None
+
+
+def post_demotion_report(rank: int, ewma: float, threshold: float,
+                         cycles: int) -> bool:
+    """Post the coordinator's chronic-straggler verdict to the driver.
+
+    Epoch-stamped and best-effort, mirroring ``request_reset``: the
+    driver honors a CURRENT-epoch report only, so a report that races an
+    epoch bump simply expires.  The payload carries the EWMA evidence so
+    the driver log and flight recorder agree on *why* the host was shed.
+    Returns whether the report was posted (False outside elastic jobs —
+    the verdict is then detector-only)."""
+    store = store_client()
+    if store is None:
+        return False
+    payload = json.dumps({
+        "epoch": env_mod.get_epoch(),
+        "rank": rank,
+        "hostname": _resolve_hostname(store, rank),
+        "ewma": round(ewma, 6),
+        "threshold": threshold,
+        "cycles": cycles,
+        "posted_unix": time.time(),
+    }).encode()
+    try:
+        store.set(DEMOTION_REPORT_SCOPE, _identity(), payload)
+        return True
+    except Exception:  # noqa: BLE001 — a slow host is a degradation, not
+        # an emergency; an unreachable store must not turn the verdict
+        # into a job-killing error
         return False
 
 

@@ -7,6 +7,16 @@ it (``transport.store.HTTPStoreClient``), the elastic driver publishes slot
 assignments into a well-known scope, and DELETE doubles as the
 worker-finalized notification hook.
 
+Worker → driver back-channels ride the same KV plane as plain scopes, no
+dedicated endpoints: ``reset_request`` (a surviving-but-aborted worker
+asks for a fresh epoch, ``elastic/rendezvous_client.request_reset``) and
+``demotion_report`` (the coordinator's chronic-straggler verdict,
+``post_demotion_report`` — the driver blacklists the named host and
+advances the epoch with ``cause="demotion"``).  Both are epoch-stamped
+and read by the driver's per-tick batched transaction
+(``ElasticDriver._tick_store_reads`` riding ``POST /batch``), so stale
+entries expire by staleness, never by deletion round-trips.
+
 Observability additions (docs/observability.md): workers push metrics
 snapshots into the ``metrics`` scope (``PUT /metrics/rank-N``), and two
 special GET paths serve the cluster view — ``GET /metrics`` renders the

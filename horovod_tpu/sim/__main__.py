@@ -8,6 +8,14 @@ REAL journaled rendezvous server + elastic driver over a shaped wire and
 writes one artifact record per ``--np``; see docs/sim_cluster.md.
 Determinism: fix ``--seed`` (or ``HOROVOD_SIM_SEED``) and the schedule +
 wire digest reproduce exactly.
+
+``--demotions N`` switches to the self-healing demotion lane instead:
+N chronic-straggler demotion reports drive blacklist + epoch advance
+through the real driver, and the record is the flag→blacklist→first-step
+latency curve (docs/elastic.md "self-healing demotion")::
+
+    python -m horovod_tpu.sim --np 128 --demotions 3 \\
+        --out benchmarks/results/sim_demotion_np128.json
 """
 
 from __future__ import annotations
@@ -27,6 +35,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--slots-per-host", type=int, default=8)
     p.add_argument("--events", type=int, default=6,
                    help="churn events per run (last = coordinated abort)")
+    p.add_argument("--demotions", type=int, default=0,
+                   help="run the demotion lane instead: this many "
+                        "chronic-straggler demotions per run")
     p.add_argument("--seed", type=int, default=None,
                    help="override HOROVOD_SIM_SEED")
     p.add_argument("--lease-timeout", type=float, default=1.5)
@@ -42,7 +53,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             np_, slots_per_host=args.slots_per_host, seed=args.seed,
             lease_timeout=args.lease_timeout,
             renew_period=args.renew_period, trace=not args.no_trace)
-        rec = cluster.run(args.events)
+        rec = cluster.run_demotion(args.demotions) if args.demotions \
+            else cluster.run(args.events)
         line = json.dumps(rec)
         print(line, flush=True)
         lines.append(line)

@@ -51,7 +51,10 @@ from ..core import metrics
 from ..core.timeline import DRIVER_TRACE_PID, Timeline
 from ..elastic.discovery import FixedHosts, HostManager
 from ..elastic.driver import ElasticDriver
-from ..elastic.rendezvous_client import RESET_REQUEST_SCOPE
+from ..elastic.rendezvous_client import (
+    DEMOTION_REPORT_SCOPE,
+    RESET_REQUEST_SCOPE,
+)
 from ..runner.hosts import HostInfo, SlotInfo
 from ..runner.rendezvous import ExternalRendezvous, RendezvousServer
 from ..transport.store import LEASE_SCOPE, HTTPStoreClient
@@ -101,10 +104,15 @@ class SimCluster:
     def __init__(self, np: int, slots_per_host: int = 8,
                  seed: Optional[int] = None,
                  lease_timeout: float = 1.5, renew_period: float = 0.25,
-                 trace: bool = True):
+                 trace: bool = True, min_np: Optional[int] = None):
         if seed is None:
             seed = env_mod.get_int(env_mod.HOROVOD_SIM_SEED, 0)
         self.np = np
+        # Churn runs pin min_np == np (every epoch restores full
+        # capacity); demotion runs SHED hosts without replacement, so
+        # they must leave headroom or the driver would wait for capacity
+        # that never comes (run_demotion computes the floor itself).
+        self.min_np = np if min_np is None else min_np
         self.slots_per_host = slots_per_host
         self.seed = seed
         self.lease_timeout = lease_timeout
@@ -164,7 +172,7 @@ class SimCluster:
                                self._wire("driver")))
         self.driver = ElasticDriver(
             rendezvous, HostManager(FixedHosts(self._host_infos)),
-            min_np=self.np, max_np=self.np,
+            min_np=self.min_np, max_np=self.np,
             lease_timeout=self.lease_timeout)
         self.driver.start(self._spawn_worker)
         if metrics.ENABLED:
@@ -404,6 +412,178 @@ class SimCluster:
             "determinism": {
                 "digest": self.determinism_digest(events),
                 "schedule": [list(p) for p in plan],
+            },
+        }
+        if attribution is not None:
+            rec["attribution"] = attribution
+        return rec
+
+    # -- self-healing demotion (docs/elastic.md) -----------------------
+    #
+    # A separate runner, NOT a new EVENT_KINDS member: adding a kind
+    # would reshuffle every existing churn schedule (and so every
+    # committed determinism digest) for the same seed.
+
+    def demotion_schedule(self, demotions: int) -> List[str]:
+        """Deterministic demotion plan: ``demotions`` DISTINCT victim
+        hosts sampled from everything but the coordinator's host (the
+        whole-world-slow guard aside, rank 0 reporting its own host
+        would shed the coordinator mid-verdict — not the scenario this
+        lane measures).  Pure function of (seed, topology)."""
+        if demotions >= len(self.hostnames):
+            raise ValueError(
+                f"{demotions} demotions need at least {demotions + 1} "
+                f"hosts (have {len(self.hostnames)})")
+        rng = random.Random(f"{self.seed}:demotion")
+        return rng.sample(self.hostnames[1:], demotions)
+
+    def inject_demotion(self, victim_host: str) -> int:
+        """Post a coordinator demotion report naming ``victim_host``'s
+        first live rank, over the coordinator host's shaped link — the
+        exact store write ``post_demotion_report`` makes.  The EWMA
+        evidence is synthesized (the verdict machinery upstream of the
+        report is proven by the unit + np=3 chaos lanes); everything
+        downstream — report parse, staleness rule, blacklist, epoch
+        advance, metrics — is the REAL driver code."""
+        epoch = self.driver.epoch
+        victim = next(w for w in self._live()
+                      if w.hostname == victim_host)
+        payload = json.dumps({
+            "epoch": epoch,
+            "rank": victim.rank,
+            "hostname": victim_host,
+            "ewma": 3.0 * self.lease_timeout,
+            "threshold": self.lease_timeout,
+            "cycles": 10,
+            "posted_unix": time.time(),
+        }).encode()
+        self._host_clients[self.hostnames[0]].batch([
+            ("set", DEMOTION_REPORT_SCOPE, self.identities[0], payload)])
+        if metrics.ENABLED:
+            metrics.inc("sim_churn_events_total", kind="demotion")
+        self.driver._wakeup.set()
+        return victim.rank
+
+    def demotion_digest(self, demotions: int) -> str:
+        """Demotion-lane analog of :meth:`determinism_digest`: SHA-256
+        over the demotion plan, slot layout, capacity floor, and wire
+        previews — reproducibility witness for the committed artifact."""
+        links = {link: self._probe_wire(link).preview(4096, 4)
+                 for link in ["driver"] + self.hostnames}
+        blob = json.dumps({
+            "seed": self.seed, "np": self.np, "min_np": self.min_np,
+            "slots_per_host": self.slots_per_host,
+            "identities": self.identities,
+            "demotion_schedule": self.demotion_schedule(demotions),
+            "wire_previews": links,
+        }, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def run_demotion(self, demotions: int, keep_dirs: bool = False) -> dict:
+        """Drive ``demotions`` chronic-straggler demotions through the
+        real driver and return the demotion-latency artifact: per event,
+        flag→epoch (report posted to the shed host's epoch published)
+        and flag→first-round (through the first completed control round
+        of the NEW world — the control-plane floor under the first
+        training step, since simulated workers take no steps)."""
+        plan = self.demotion_schedule(demotions)
+        shed = sum(hi.slots for hi in self._host_infos
+                   if hi.hostname in plan)
+        if self.min_np > self.np - shed:
+            # Shedding below min_np would park the driver at "waiting
+            # for capacity" forever (FixedHosts never adds machines).
+            self.min_np = self.np - shed
+        # The registry is process-global and runs can share a process
+        # (test suites): report THIS run's demotion transitions.
+        base_transitions = metrics.registry.get_counter(
+            "driver_epoch_transitions_total", cause="demotion")
+        t0 = time.perf_counter()
+        self.start()
+        bringup_ms = (time.perf_counter() - t0) * 1e3
+        event_records: List[dict] = []
+        try:
+            for _ in range(2):
+                self.renewal_round()
+                time.sleep(self.renew_period)
+            for victim_host in plan:
+                target = self.driver.epoch + 1
+                t_flag = time.perf_counter()
+                rank = self.inject_demotion(victim_host)
+                self.await_epoch(
+                    target, timeout=30.0 + 3 * self.lease_timeout)
+                t_epoch = time.perf_counter()
+                self.ack_round(self.driver.epoch)
+                # The shed host's ranks saw rank -1 and exited (real
+                # workers do this from refresh_topology_from_rendezvous
+                # after acking).
+                for w in self.workers.values():
+                    if w.hostname == victim_host:
+                        w.renewing = False
+                self.renewal_round()
+                t_step = time.perf_counter()
+                event_records.append({
+                    "victim_host": victim_host,
+                    "rank": rank,
+                    "epoch": self.driver.epoch,
+                    "flag_to_epoch_ms": round((t_epoch - t_flag) * 1e3, 3),
+                    "flag_to_first_round_ms": round(
+                        (t_step - t_flag) * 1e3, 3),
+                })
+                if metrics.ENABLED:
+                    metrics.set_gauge("sim_identities", len(self._live()))
+                time.sleep(self.renew_period)
+        finally:
+            self.stop(keep_dirs=True)  # dirs still needed below
+
+        attribution = None
+        if self.trace:
+            from ..tools.control_path import analyze
+            from ..tools.trace_merge import load_trace, merge
+
+            doc = analyze(merge([
+                load_trace(os.path.join(self._tdir, "server.json")),
+                load_trace(os.path.join(self._tdir, "driver.json"))]))
+            attribution = {
+                "coverage": doc["coverage"],
+                "phase_share": doc["phase_share"],
+                "event_wall_ms_p50": round(doc["wall_us"]["p50"] / 1e3, 3),
+            }
+        journal_bytes = sum(
+            os.path.getsize(os.path.join(self._jdir, f))
+            for f in os.listdir(self._jdir))
+        if not keep_dirs:
+            for d in (self._jdir, self._tdir):
+                if d:
+                    shutil.rmtree(d, ignore_errors=True)
+
+        epoch_lat = sorted(e["flag_to_epoch_ms"] for e in event_records)
+        step_lat = sorted(e["flag_to_first_round_ms"]
+                          for e in event_records)
+        rec = {
+            "metric": "sim_demotion",
+            "np": self.np,
+            "min_np": self.min_np,
+            "hosts": len(self.hostnames),
+            "slots_per_host": self.slots_per_host,
+            "seed": self.seed,
+            "lease_timeout_s": self.lease_timeout,
+            "renew_period_s": self.renew_period,
+            "final_epoch": self.driver.epoch,
+            "bringup_ms": round(bringup_ms, 3),
+            "events": event_records,
+            "flag_to_epoch_ms_p50": epoch_lat[len(epoch_lat) // 2],
+            "flag_to_epoch_ms_max": epoch_lat[-1],
+            "flag_to_first_round_ms_p50": step_lat[len(step_lat) // 2],
+            "flag_to_first_round_ms_max": step_lat[-1],
+            "driver_demotion_transitions": metrics.registry.get_counter(
+                "driver_epoch_transitions_total",
+                cause="demotion") - base_transitions,
+            "sim_wire_delay_s": round(
+                sum(w.injected_s for w in self._wires.values()), 4),
+            "journal_bytes": journal_bytes,
+            "determinism": {
+                "digest": self.demotion_digest(demotions),
+                "schedule": list(plan),
             },
         }
         if attribution is not None:

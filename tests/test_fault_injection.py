@@ -138,6 +138,42 @@ class TestFaultSpec:
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
         assert out.stdout.strip() == "True", (out.stdout, out.stderr)
 
+    def test_inject_deferred_returns_delay_without_sleeping(self):
+        """The tally site's deferral contract: a delay_ms clause hands the
+        delay back (in seconds) instead of sleeping, so the coordinator
+        can park the tally rather than stall its whole lockstep cycle."""
+        faults.configure("controller.tally:rank=1:action=delay_ms,150")
+        t0 = time.monotonic()
+        delay = faults.inject_deferred("controller.tally", rank=1)
+        assert time.monotonic() - t0 < 0.1, "inject_deferred slept"
+        assert delay == pytest.approx(0.150)
+
+    def test_inject_deferred_rank_filter(self):
+        faults.configure("controller.tally:rank=1:action=delay_ms,150")
+        assert faults.inject_deferred("controller.tally", rank=0) == 0.0
+        assert faults.inject_deferred("controller.tally", rank=2) == 0.0
+
+    def test_inject_deferred_non_delay_actions_still_run(self):
+        """Only delay_ms is deferred; raise keeps its normal semantics
+        through the deferred entry point."""
+        faults.configure("controller.tally:action=raise")
+        with pytest.raises(faults.FaultInjectedError):
+            faults.inject_deferred("controller.tally", rank=0)
+
+    def test_inject_deferred_nth_fires_once(self):
+        faults.configure("controller.tally:rank=1:nth=2:action=delay_ms,200")
+        assert faults.inject_deferred("controller.tally", rank=1) == 0.0
+        assert faults.inject_deferred("controller.tally", rank=1) \
+            == pytest.approx(0.200)
+        assert faults.inject_deferred("controller.tally", rank=1) == 0.0
+
+    def test_inject_deferred_after_fires_every_call(self):
+        faults.configure("controller.tally:rank=1:after=1:action=delay_ms,50")
+        assert faults.inject_deferred("controller.tally", rank=1) == 0.0
+        for _ in range(3):
+            assert faults.inject_deferred("controller.tally", rank=1) \
+                == pytest.approx(0.050)
+
 
 # ---------------------------------------------------------------------------
 # chaos: subprocess worker jobs under injected faults
@@ -715,6 +751,144 @@ def test_elastic_recovers_from_injected_rank_death(tmp_path):
     assert "ELASTIC_DONE" in proc.stdout, proc.stdout[-2000:]
     assert "size=2" in proc.stdout, "never ran at full size"
     assert "size=1" in proc.stdout, "never recovered at reduced size"
+
+
+# ---------------------------------------------------------------------------
+# self-healing straggler demotion (docs/elastic.md "self-healing demotion")
+# ---------------------------------------------------------------------------
+
+# Averaging allreduce (the default op) with IDENTICAL per-rank
+# contributions: the average equals the contribution at every world size,
+# so a run that sheds a host mid-training must still land on params
+# BIT-identical to an undisturbed run.  Contributions are small integers
+# (exact in fp32; sum/divide round-trips exactly), so "bit-identical" is
+# a meaningful assertion, not a tolerance.
+_ELASTIC_DEMOTION_TRAIN = """
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0, params=np.zeros(4, np.float32))
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 30:
+        grad = hvd.allreduce(
+            np.full(4, float(state.batch + 1), np.float32), name="g")
+        state.params = state.params + np.asarray(grad)
+        state.batch += 1
+        state.commit()
+
+train(state)
+print("FINAL_PARAMS r%d %s" % (
+    hvd.rank(), np.asarray(state.params).tobytes().hex()), flush=True)
+print("ELASTIC_DONE", hvd.rank(), flush=True)
+hvd.shutdown()
+"""
+
+# Aggressive-but-stable detector tuning for a CI-sized job.  The chronic
+# clause defers rank 1's tallies by 300ms per cycle, far over the 0.1s
+# demote threshold; 3 consecutive over-threshold cycles take ~1s of
+# wall-clock.  The response cache must be OFF: cache-bit announcements
+# bypass the request-table tally path the controller.tally site lives on
+# (docs/fault_injection.md).
+_DEMOTION_KNOBS = {
+    "HOROVOD_STRAGGLER_THRESHOLD_SECS": "0.08",
+    "HOROVOD_STRAGGLER_EWMA_ALPHA": "0.5",
+    "HOROVOD_STRAGGLER_DEMOTE_SECS": "0.1",
+    "HOROVOD_STRAGGLER_DEMOTE_CYCLES": "3",
+    "HOROVOD_CACHE_CAPACITY": "0",
+    "HOROVOD_LOCK_DEBUG": "1",
+}
+
+
+def _run_demotion_job(tmp_path, fault_spec, min_np=2, extra_env=None):
+    """np=3 elastic job across three loopback 'hosts' (one slot each) so a
+    demotion sheds exactly one host.  Returns (rank->params map, proc)."""
+    disc = tmp_path / "discover3.sh"
+    disc.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n"
+                    "echo 127.0.0.2:1\n")
+    disc.chmod(0o755)
+    train = tmp_path / f"train_{'fault' if fault_spec else 'clean'}.py"
+    train.write_text(_ELASTIC_DEMOTION_TRAIN)
+
+    env = os.environ.copy()
+    env.update(_FAST_DEADLINE)
+    env.update(_DEMOTION_KNOBS)
+    env.update(extra_env or {})
+    env["HOROVOD_LOG_LEVEL"] = "info"  # driver logs the demotion cause
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    if fault_spec:
+        env["HOROVOD_FAULT_SPEC"] = fault_spec
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "3", "--min-np", str(min_np),
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        capture_output=True, timeout=240)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])
+    params = dict(re.findall(r"FINAL_PARAMS r(\d+) ([0-9a-f]+)",
+                             proc.stdout))
+    assert params, proc.stdout[-2000:]
+    assert len(set(params.values())) == 1, "ranks diverged"
+    return params, proc
+
+
+@pytest.mark.timeout(600)
+def test_chronic_straggler_demoted_job_converges_bit_identical(tmp_path):
+    """The tentpole end to end: a chronically slow rank (every tally
+    deferred 300ms via controller.tally) trips the demotion state machine,
+    the coordinator posts the verdict over the rendezvous store, the
+    driver blacklists the straggler's host and advances the epoch with
+    cause=demotion, and the surviving np=2 world finishes with params
+    BIT-identical to an undisturbed np=3 run."""
+    clean, _ = _run_demotion_job(tmp_path, None)
+    assert set(clean) == {"0", "1", "2"}
+    faulted, proc = _run_demotion_job(
+        tmp_path, "controller.tally:rank=1:after=0:action=delay_ms,300")
+    # The straggler's host was shed: the run finished at size 2, and the
+    # demoted worker never printed final params.
+    assert set(faulted) == {"0", "1"}, proc.stdout[-2000:]
+    assert faulted["0"] == clean["0"], \
+        "demoted run did not converge to the no-fault run"
+    # The full demotion chain is visible in the driver/coordinator logs:
+    # chronic verdict -> blacklist with EWMA evidence -> epoch advance
+    # attributed to the demotion (not to a worker death or reset).
+    assert "chronic straggler" in proc.stderr, proc.stderr[-3000:]
+    assert "blacklisting host 127.0.0.1" in proc.stderr, proc.stderr[-3000:]
+    assert "readiness-lag EWMA" in proc.stderr, proc.stderr[-3000:]
+    assert "cause=demotion" in proc.stderr, proc.stderr[-3000:]
+    assert "advancing epoch" in proc.stderr, proc.stderr[-3000:]
+
+
+@pytest.mark.timeout(600)
+def test_one_shot_straggle_flags_but_does_not_demote(tmp_path):
+    """Demotion false-positive guard: a single 200ms spike trips the
+    straggler FLAG (threshold 0.05s) but can never fill the demotion
+    window — the lag EWMA is bounded by the largest observed lag (~0.2s),
+    which stays strictly under the 0.3s demote threshold, so no streak
+    ever starts.  The job keeps all three ranks and still converges
+    bit-identically to the clean run: flagging is free, shedding is not."""
+    spike_knobs = {"HOROVOD_STRAGGLER_THRESHOLD_SECS": "0.05",
+                   "HOROVOD_STRAGGLER_DEMOTE_SECS": "0.3"}
+    clean, _ = _run_demotion_job(tmp_path, None, extra_env=spike_knobs)
+    faulted, proc = _run_demotion_job(
+        tmp_path, "controller.tally:rank=1:nth=3:action=delay_ms,200",
+        extra_env=spike_knobs)
+    assert set(faulted) == {"0", "1", "2"}, \
+        "a one-shot delay cost the job a host"
+    assert faulted["0"] == clean["0"]
+    assert "straggler detected" in proc.stderr, \
+        "the spike never even flagged — the test exercised nothing"
+    assert "chronic straggler" not in proc.stderr, proc.stderr[-3000:]
+    assert "blacklisting host" not in proc.stderr, proc.stderr[-3000:]
+    assert "cause=demotion" not in proc.stderr, proc.stderr[-3000:]
 
 
 # ---------------------------------------------------------------------------

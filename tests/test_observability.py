@@ -541,6 +541,202 @@ class TestStragglerDetector:
         # a missing rank with no observed lag yields no accusation
         assert c._lag_suffix([0]) == ""
 
+    # -- suspect-reset regression (ISSUE 17 satellite): demotion keys
+    #    off live state, never a previous world's leftovers ------------
+
+    def test_decay_clears_suspect_gauge_to_minus_one(self):
+        c = self._controller(thresh=0.05, alpha=0.5)
+        self._lagging_entry(c, age=1.0)
+        c._update_stragglers()
+        assert metrics.registry.get_gauge("straggler_suspect") == 1
+        c._message_table.clear()
+        for _ in range(50):
+            c._update_stragglers()
+        assert c._straggler_suspects == set()
+        assert metrics.registry.get_gauge("straggler_suspect") == -1
+        # ...and the decay loop itself un-wedges: once every EWMA is
+        # at noise floor, the early-out flag drops back to False.
+        assert c._straggler_decaying is False
+
+    def test_fresh_controller_resets_stale_suspect_gauge(self):
+        # An elastic epoch restart in the same process builds a NEW
+        # controller; the process-global gauge must not keep naming the
+        # old world's suspect (the demotion plane reads live state).
+        c = self._controller(thresh=0.05, alpha=1.0)
+        self._lagging_entry(c, age=1.0)
+        c._update_stragglers()
+        assert metrics.registry.get_gauge("straggler_suspect") == 1
+        c2 = self._controller()
+        assert metrics.registry.get_gauge("straggler_suspect") == -1
+        assert c2._straggler_decaying is False
+        assert c2._straggler_ewma == {}
+        # the fresh world's clean cycles stay clean (no wedge from the
+        # old controller's state)
+        c2._update_stragglers()
+        assert c2._straggler_suspects == set()
+
+
+# ---------------------------------------------------------------------------
+# chronic-straggler demotion: the verdict state machine as a pure unit
+# (ISSUE 17; docs/elastic.md "self-healing demotion")
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestDemotionPolicy:
+    def _policy(self, secs=1.0, cycles=3):
+        from horovod_tpu.core.controller import DemotionPolicy
+
+        return DemotionPolicy(secs, cycles)
+
+    def test_disabled_by_default_threshold(self):
+        p = self._policy(secs=0.0)
+        assert not p.enabled
+        assert p.observe(0, {1: 99.0}, {0, 1, 2}) is None
+
+    def test_cycles_validation(self):
+        with pytest.raises(ValueError, match="DEMOTE_CYCLES"):
+            self._policy(cycles=0)
+
+    def test_hysteresis_window_edges(self):
+        # Table-driven: cycles of (ewma map, expected verdict).  The
+        # verdict fires exactly ON the Nth consecutive over-threshold
+        # cycle, not before, and a single under-threshold cycle resets
+        # the streak to zero.
+        p = self._policy(secs=1.0, cycles=3)
+        world = {0, 1, 2}
+        cases = [
+            ({1: 2.0}, None),   # streak 1
+            ({1: 2.0}, None),   # streak 2
+            ({1: 0.5}, None),   # dips under: streak resets
+            ({1: 2.0}, None),   # streak 1 again
+            ({1: 2.0}, None),   # streak 2
+            ({1: 2.0}, 1),      # streak 3 == cycles: verdict
+        ]
+        for i, (ewma, expected) in enumerate(cases):
+            assert p.observe(0, ewma, world) == expected, f"cycle {i}"
+
+    def test_exactly_at_threshold_is_not_over(self):
+        # strict >: an EWMA sitting exactly on the knob never streaks
+        p = self._policy(secs=1.0, cycles=1)
+        assert p.observe(0, {1: 1.0}, {0, 1, 2}) is None
+        assert p.observe(0, {1: 1.0001}, {0, 1, 2}) == 1
+
+    def test_whole_world_slow_guard(self):
+        # Half-or-more of the active world over threshold = a global
+        # stall, not a straggler: nobody is demoted and streaks reset.
+        p = self._policy(secs=1.0, cycles=2)
+        world = {0, 1, 2, 3}
+        slow_world = {1: 5.0, 2: 5.0}          # 2 of 4 = half
+        for _ in range(10):
+            assert p.observe(0, slow_world, world) is None
+        # the stall must not have seeded streaks: rank 1 alone still
+        # needs the FULL window from zero
+        assert p.observe(0, {1: 5.0}, world) is None
+        assert p.observe(0, {1: 5.0}, world) == 1
+
+    def test_two_rank_world_never_demotes(self):
+        # At np=2 one slow rank is half the world — the guard blocks
+        # demotion by construction, no special case needed.
+        p = self._policy(secs=1.0, cycles=1)
+        for _ in range(5):
+            assert p.observe(0, {1: 99.0}, {0, 1}) is None
+
+    def test_one_demotion_per_epoch_cap(self):
+        p = self._policy(secs=1.0, cycles=1)
+        world = {0, 1, 2, 3, 4}
+        assert p.observe(7, {1: 5.0}, world) == 1
+        # rank 3 is just as chronic, but epoch 7 already shed a host
+        for _ in range(10):
+            assert p.observe(7, {3: 5.0}, world) is None
+        # a new epoch re-arms the cap
+        assert p.observe(8, {3: 5.0}, world) == 3
+
+    def test_worst_ewma_wins_among_chronic(self):
+        p = self._policy(secs=1.0, cycles=2)
+        world = {0, 1, 2, 3, 4, 5, 6}
+        both = {1: 2.0, 3: 9.0}
+        assert p.observe(0, both, world) is None
+        assert p.observe(0, both, world) == 3
+
+    def test_recovered_rank_drops_from_streaks(self):
+        p = self._policy(secs=1.0, cycles=3)
+        world = {0, 1, 2, 3, 4}
+        p.observe(0, {1: 5.0, 3: 5.0}, world)
+        p.observe(0, {1: 5.0, 3: 5.0}, world)
+        # rank 3 recovers; rank 1 completes the window alone
+        assert p.observe(0, {1: 5.0}, world) == 1
+        # rank 3's streak was wiped, not frozen
+        assert p.observe(1, {3: 5.0}, world) is None
+
+
+# ---------------------------------------------------------------------------
+# demotion report parsing (driver side, no sockets) + blacklist strikes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestDemotionReports:
+    def _parse(self, raws, epoch):
+        from horovod_tpu.elastic.driver import ElasticDriver
+
+        return ElasticDriver._parse_demotion_reports(raws, epoch)
+
+    def _report(self, epoch=3, rank=1, **extra):
+        d = {"epoch": epoch, "rank": rank, "hostname": "h001",
+             "ewma": 2.5, "threshold": 1.0, "cycles": 10}
+        d.update(extra)
+        return json.dumps(d).encode()
+
+    def test_current_epoch_report_parses(self):
+        reps = self._parse({"h000:0": self._report(epoch=3)}, epoch=3)
+        assert len(reps) == 1
+        assert reps[0]["rank"] == 1
+        assert reps[0]["reporter"] == "h000:0"
+
+    def test_stale_epoch_report_discarded(self):
+        # A report stamped with an older epoch was answered by a later
+        # bump already — it must not demote anyone in the new world.
+        for stale in (0, 1, 2):
+            assert self._parse(
+                {"h000:0": self._report(epoch=stale)}, epoch=3) == []
+        # future-stamped reports (clock/restart skew) are equally dead
+        assert self._parse(
+            {"h000:0": self._report(epoch=9)}, epoch=3) == []
+
+    def test_absent_and_malformed_reports_skipped(self):
+        raws = {"h000:0": None, "h001:0": b"not json",
+                "h002:0": b"[1,2]", "h003:0": json.dumps(
+                    {"epoch": 3, "rank": "one"}).encode()}
+        assert self._parse(raws, epoch=3) == []
+
+    def test_blacklist_idempotent_while_active(self):
+        from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+        from horovod_tpu.runner.hosts import parse_hosts
+
+        hm = HostManager(FixedHosts(parse_hosts("a:1,b:1")),
+                         blacklist_cooldown=60.0)
+        assert hm.blacklist("a", evidence="rank 1 EWMA 2.5s") is True
+        expiry = hm._blacklist["a"]
+        # repeated strikes within the window: no stacking, expiry KEPT
+        assert hm.blacklist("a", evidence="again") is False
+        assert hm.blacklist("a") is False
+        assert hm._blacklist["a"] == expiry
+        assert hm.is_blacklisted("a")
+        assert not hm.is_blacklisted("b")
+
+    def test_blacklist_fresh_strike_after_expiry(self):
+        from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+        from horovod_tpu.runner.hosts import parse_hosts
+
+        hm = HostManager(FixedHosts(parse_hosts("a:1")),
+                         blacklist_cooldown=60.0)
+        assert hm.blacklist("a") is True
+        # simulate cooldown expiry
+        hm._blacklist["a"] = hm._now() - 1.0
+        assert hm.blacklist("a") is True  # a NEW strike, clock restarted
+        assert hm._blacklist["a"] > hm._now()
+
 
 # ---------------------------------------------------------------------------
 # trace merge
