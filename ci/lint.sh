@@ -1,8 +1,9 @@
 #!/bin/sh
 # Lint lane (mirrors ci/chaos.sh): the hvd-lint static pass over the
-# package, the hvd-mck exhaustive model-check of the shm ring protocol,
+# package, the hvd-mck exhaustive model-checks of the shm ring protocol
+# and of the elastic epoch protocol (crash/reorder, `hvd-mck proto`),
 # plus their test suites (per-rule fixtures, the zero-violation tree
-# contract, the mutation-kill suite, and the lockdep unit tests).  Fast
+# contract, the mutation-kill suites, and the lockdep unit tests).  Fast
 # — run it FIRST: a reopened invariant (blocking call under a lock,
 # typo'd fault site, reordered doorbell publish) fails here in seconds
 # instead of wedging a multiprocess job in the chaos lane.
@@ -11,6 +12,13 @@
 set -eu
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$ROOT"
+
+# Sweep stale flight-recorder dumps BEFORE asserting, the way the
+# chaos/bench lanes already do: a crashed earlier run leaves
+# hvd_flight_recorder/ post-mortems in the cwd, and any dump-presence
+# assertion in the suites below would judge last week's wreckage
+# instead of this run's.
+rm -rf hvd_flight_recorder/ hvd_flight_recorder.rank*.json
 
 rc=0
 {
@@ -36,8 +44,31 @@ rc=0
       fi; } &&
     # The checker's checker: every seeded protocol bug killed by name.
     python -m horovod_tpu.tools.mck --mutants -q &&
+    # The elastic epoch protocol under the same engine: every scenario
+    # COMPLETE and clean — TRUNCATED exits 2 and fails the lane; an
+    # incomplete exploration must never pass as proof.  The JSON report
+    # is this lane's second machine-readable artifact.
+    python -m horovod_tpu.tools.mck proto --smoke -q \
+        --json ci/mck.proto.report.json &&
+    # The proto teeth guard (the weak-mode idiom, for this protocol): a
+    # seeded bug run as a plain check MUST exit 1 — violations found,
+    # specifically — not 0 (checker gone blind) and not a crash.
+    { inject_rc=0; python -m horovod_tpu.tools.mck proto \
+          --inject apply_before_journal -q > /dev/null 2>&1 \
+          || inject_rc=$?
+      if [ "$inject_rc" -eq 1 ]; then
+          echo "hvd-mck proto: injected WAL inversion is found (expected)"
+      else
+          echo "hvd-mck proto: injected run exited $inject_rc, expected" \
+               "1 (violations found) — the checker can no longer detect" \
+               "the bug class it exists for"
+          false
+      fi; } &&
+    # And the full proto kill suite: every seeded protocol bug dead.
+    python -m horovod_tpu.tools.mck proto --mutants -q &&
     JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py tests/test_mck.py \
-        tests/test_lockdep.py -q -p no:cacheprovider "$@"
+        tests/test_mck_proto.py tests/test_lockdep.py -q \
+        -p no:cacheprovider "$@"
 } > ci/lint.last.log 2>&1 || rc=$?
 cat ci/lint.last.log
 [ "$rc" -eq 0 ] || { echo "lint lane FAILED (rc=$rc)"; exit "$rc"; }

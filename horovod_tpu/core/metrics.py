@@ -45,7 +45,9 @@ from ..common import env as env_mod
 
 #: Rendezvous-KV scope the workers push snapshots into (``PUT
 #: /metrics/rank-N``) and the server's ``GET /metrics`` aggregates from.
-METRICS_SCOPE = "metrics"
+#: Re-exported from the scope registry (transport/scopes.py, HVD010) at
+#: the BOTTOM of this module: importing the transport package pulls in
+#: core/timeline, which needs ``metrics.registry`` to exist already.
 
 #: Prefix stamped onto every rendered Prometheus series.
 PROM_PREFIX = "hvd_"
@@ -505,3 +507,9 @@ def render_prometheus(snapshots: Dict) -> str:
                    f"{_fmt(total)}")
         out.append(f"{PROM_PREFIX}{flat(base + '_count', **labels)} {n}")
     return "\n".join(out) + ("\n" if out else "")
+
+
+# Deferred re-export (see the note near the top of the module): the
+# transport package import chain reaches back into ``metrics.registry``,
+# so the scope registry can only be imported once that exists.
+from ..transport.scopes import METRICS_SCOPE  # noqa: E402,F401  (re-export)

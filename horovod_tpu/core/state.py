@@ -299,7 +299,7 @@ class HorovodGlobalState:
         import json as json_mod
 
         from ..elastic import fanin as fanin_mod
-        from ..transport.store import LEASE_SCOPE
+        from ..elastic.rendezvous_client import lease_renew_ops
 
         fanin = fanin_mod.maybe_create(store, period)
 
@@ -326,12 +326,9 @@ class HorovodGlobalState:
             # ranks that left at an elastic re-rendezvous (their last
             # push would otherwise be served forever).
             snap["epoch"] = env_mod.get_epoch()
-            lease = json_mod.dumps({
-                "rank": rank, "epoch": env_mod.get_epoch(),
-                "renewals": renewals[0]}).encode()
-            ops = [("set", metrics.METRICS_SCOPE, f"rank-{rank}",
-                    json_mod.dumps(snap).encode()),
-                   ("set", LEASE_SCOPE, identity, lease)]
+            ops = lease_renew_ops(identity, rank, env_mod.get_epoch(),
+                                  renewals[0],
+                                  json_mod.dumps(snap).encode())
             try:
                 # Fan-in first: True means the ops were delivered (or
                 # spooled under a live host aggregator); False means no

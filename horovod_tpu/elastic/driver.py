@@ -42,6 +42,7 @@ from ..core import metrics
 from ..core import timeline as timeline_mod
 from ..runner.hosts import SlotInfo, get_host_assignments
 from ..runner.rendezvous import ExternalRendezvous, RendezvousServer
+from ..transport.scopes import EPOCH_ACK_SCOPE, RANK_AND_SIZE_SCOPE
 from ..transport.store import LEASE_SCOPE
 from .constants import (
     DEFAULT_CRASH_FAILURE_LIMIT,
@@ -59,7 +60,289 @@ log = get_logger("horovod_tpu.elastic.driver")
 
 #: Scope the driver persists its own durable state in (currently just the
 #: epoch) so a restarted driver can re-adopt instead of resetting to 0.
-DRIVER_SCOPE = "driver"
+#: Re-exported from the scope registry (transport/scopes.py, HVD010).
+from ..transport.scopes import DRIVER_SCOPE  # noqa: E402  (re-export)
+
+
+# -- epoch-judgment kernel (model-checked; see tools/mck proto) ---------------
+#
+# The per-tick membership judgment — fetch, stale-report filtering, lease
+# scan, blacklist-before-discovery-poll, cause-precedence epoch advance —
+# is written ONCE, as pure generators over an abstract driver: every
+# side effect is one yielded step tuple, in exact program order, and the
+# caller executes it against the live store/host manager/clock — or,
+# under ``hvd-mck proto``, against a model cluster where messages
+# reorder, processes crash at any yield point, and the lease clock is an
+# explored action.  The model-checked code IS the production code; the
+# orderings the checker proves (blacklist strictly before the host poll,
+# at most one advance per judged tick, stale reports filtered before
+# they can name a cause) are properties of THESE generators, not of a
+# parallel description that could drift (exactly the extraction pattern
+# transport/shm.py uses for the ring protocol).
+#
+# Step vocabulary (first element is the kind; the driver answers reads
+# through ``generator.send``):
+#
+#   (STEP_TXN, ops, tag)          -> results   one batched store round-trip
+#   (STEP_CLOCK,)                 -> float     monotonic clock read
+#   (STEP_GRACE, until)                        arm the lease re-grace window
+#   (STEP_BLACKLIST, host, rep)                shed a demoted host NOW —
+#                                   strictly before this tick's host poll
+#   (STEP_POLL_HOSTS,)            -> (changed, removal)   discovery poll
+#   (STEP_GATE, which)            -> bool      advance gate ("success" /
+#                                   "reset_limit" / "capacity"); True blocks
+#   (STEP_EXPIRE, identity)                    drop a dead-leased identity
+#   (STEP_ADVANCE, cause, removalish)          THE epoch advance (at most
+#                                   one per judged tick, cause-tagged)
+
+STEP_TXN = "txn"
+STEP_CLOCK = "clock"
+STEP_GRACE = "grace"
+STEP_BLACKLIST = "blacklist"
+STEP_POLL_HOSTS = "poll_hosts"
+STEP_GATE = "gate"
+STEP_EXPIRE = "expire"
+STEP_ADVANCE = "advance"
+
+
+def pending_reset_reasons(raws: Dict[str, object], epoch: int) -> List[str]:
+    """Worker reset requests carrying the CURRENT epoch; anything older
+    was answered by a later bump already and expires in place."""
+    reasons = []
+    for identity in sorted(raws or {}):
+        raw = raws[identity]
+        if raw is None:
+            continue
+        try:
+            req = json.loads(bytes(raw).decode())
+        except ValueError:
+            continue
+        if isinstance(req, dict) and req.get("epoch", -1) == epoch:
+            reasons.append(
+                f"{identity}: {req.get('reason', 'unspecified')}")
+    return reasons
+
+
+def parse_demotion_reports(raws: Optional[Dict[str, object]],
+                           epoch: int) -> List[Dict[str, object]]:
+    """Coordinator demotion reports for the CURRENT epoch (same staleness
+    rule as reset requests); malformed payloads are skipped — this
+    channel is advisory."""
+    reports: List[Dict[str, object]] = []
+    for identity in sorted(raws or {}):
+        raw = raws[identity]
+        if raw is None:
+            continue
+        try:
+            rep = json.loads(bytes(raw).decode())
+        except (ValueError, TypeError):
+            continue
+        if isinstance(rep, dict) and rep.get("epoch", -1) == epoch \
+                and isinstance(rep.get("rank"), int):
+            rep["reporter"] = identity
+            reports.append(rep)
+    return reports
+
+
+def decide_cause(expired, demoted, reset_reasons, missing_workers) -> str:
+    """Cause precedence, mirroring the judgment order: an expired lease
+    explains the missing worker it produced, a demotion is a deliberate
+    shed of a live-but-slow host, a reset request means everyone is
+    alive, worker_exit is a death the exit monitor saw first,
+    host_change is pure discovery movement."""
+    return ("lease_expiry" if expired else
+            "demotion" if demoted else
+            "reset_request" if reset_reasons else
+            "worker_exit" if missing_workers else "host_change")
+
+
+def tick_read_steps(epoch: int, await_ack, slot_ids, removed, exited):
+    """Coalesce one tick's store reads into ONE batched round-trip and
+    unpack the results; returns the fetched dict (``epoch_ack`` /
+    ``reset`` / ``demotion`` / ``lease`` maps keyed by identity).  A get
+    of an absent key returns None, which every consumer treats as "not
+    present", so no keys-then-intersect dance is needed."""
+    slot_ids = sorted(slot_ids)
+    ack_ids = None
+    if await_ack is not None and epoch != 0:
+        ids = set(slot_ids) | set(removed)
+        ids -= set(exited)
+        ack_ids = sorted(ids)
+    ops: List[tuple] = []
+    if ack_ids is not None:
+        ops.extend(("get", EPOCH_ACK_SCOPE, i) for i in ack_ids)
+    ops.extend(("get", rendezvous_client.RESET_REQUEST_SCOPE, i)
+               for i in slot_ids)
+    ops.extend(("get", rendezvous_client.DEMOTION_REPORT_SCOPE, i)
+               for i in slot_ids)
+    ops.extend(("get", LEASE_SCOPE, i) for i in slot_ids)
+    results = yield (STEP_TXN, tuple(ops), "tick_reads")
+    idx = 0
+    out: Dict[str, Optional[Dict[str, object]]] = {"epoch_ack": None}
+    if ack_ids is not None:
+        out["epoch_ack"] = dict(
+            zip(ack_ids, results[idx:idx + len(ack_ids)]))
+        idx += len(ack_ids)
+    out["reset"] = dict(zip(slot_ids, results[idx:idx + len(slot_ids)]))
+    idx += len(slot_ids)
+    out["demotion"] = dict(
+        zip(slot_ids, results[idx:idx + len(slot_ids)]))
+    idx += len(slot_ids)
+    out["lease"] = dict(zip(slot_ids, results[idx:]))
+    return out
+
+
+def scan_lease_steps(raws: Dict[str, object],
+                     lease_seen: Dict[str, Tuple[bytes, float]],
+                     grace_until: float, lease_timeout: float):
+    """Judge lease freshness: time-since-last-VALUE-CHANGE on the clock
+    this generator reads (worker clocks never enter the judgment), with
+    no expiry before ``grace_until``.  Mutates ``lease_seen`` in place
+    (it IS the driver's tracking dict).  Returns ``(expired, min_ttl)``;
+    identities that never posted a lease are exempt."""
+    now = yield (STEP_CLOCK,)
+    identities = set(raws)
+    expired: Set[str] = set()
+    min_ttl: Optional[float] = None
+    for identity in sorted(raws):
+        raw = raws[identity]
+        if raw is None:
+            continue
+        seen = lease_seen.get(identity)
+        if seen is None or seen[0] != raw:
+            lease_seen[identity] = (raw, now)
+            ttl = lease_timeout  # fresh renewal: full budget
+        else:
+            ttl = lease_timeout - (now - seen[1])
+            if now >= grace_until and now - seen[1] > lease_timeout:
+                expired.add(identity)
+        if min_ttl is None or ttl < min_ttl:
+            min_ttl = ttl
+    # Drop tracking for identities that left the slot table.
+    for identity in list(lease_seen):
+        if identity not in identities:
+            del lease_seen[identity]
+    return expired, min_ttl
+
+
+def tick_judgment_steps(epoch: int, fetched: Dict[str, object],
+                        rank_to_host: Dict[int, str],
+                        known_identities, slot_identities,
+                        lease_seen, grace_until: float,
+                        lease_timeout: float):
+    """One judged tick, from a successful fetch to the advance decision.
+
+    The orderings the checker proves live HERE: demotion blacklists are
+    yielded strictly before the discovery poll (so a shed host drops out
+    of this very tick's host set), expiries before the missing-worker
+    computation, the gates before the advance, and STEP_ADVANCE at most
+    once.  Returns the judgment record (cause, removalish, expired,
+    missing, plus bookkeeping for logs/metrics)."""
+    reset_reasons = pending_reset_reasons(fetched["reset"], epoch)
+    reports = parse_demotion_reports(fetched["demotion"], epoch)
+    expired, min_ttl = yield from scan_lease_steps(
+        fetched["lease"], lease_seen, grace_until, lease_timeout)
+    demoted: List[str] = []
+    unresolvable: List[int] = []
+    for rep in reports:
+        rank = rep["rank"]
+        host = rank_to_host.get(rank) or rep.get("hostname")
+        if not isinstance(host, str) or not host:
+            unresolvable.append(rank)
+            continue
+        # Blacklist BEFORE the discovery poll, never after.
+        yield (STEP_BLACKLIST, host, rep)
+        demoted.append(f"rank {rank}@{host}")
+    changed, removal = yield (STEP_POLL_HOSTS,)
+    j = {
+        "advanced": False, "cause": None, "removalish": False,
+        "removal": removal, "expired": expired, "missing": set(),
+        "reset_reasons": reset_reasons, "demoted": demoted,
+        "unresolvable": unresolvable, "min_ttl": min_ttl,
+        "leases_live": len(lease_seen) - len(expired), "blocked": None,
+    }
+    if (yield (STEP_GATE, "success")):
+        # Winding down: never rendezvous a new epoch once a worker
+        # finished — a fresh slot table would assign a rank to the
+        # dead-but-successful identity and hang the survivors' mesh.
+        j["blocked"] = "success"
+        return j
+    for identity in sorted(expired):
+        # Expired with the store REACHABLE: genuinely dead (or wedged
+        # past saving) — drop it so the missing-workers path advances
+        # the epoch THIS tick, cause-tagged lease_expiry.
+        yield (STEP_EXPIRE, identity)
+    missing = set(slot_identities) - (set(known_identities) - expired)
+    j["missing"] = missing
+    if not changed and not missing and not reset_reasons and not demoted:
+        return j
+    if (yield (STEP_GATE, "reset_limit")):
+        j["blocked"] = "reset_limit"
+        return j
+    if (yield (STEP_GATE, "capacity")):
+        j["blocked"] = "capacity"
+        return j
+    # A worker-initiated reset (e.g. corruption abort with every process
+    # still alive) is removal-LIKE for sync purposes: the workers rolled
+    # back and must state.sync() after the reset.
+    removalish = removal or bool(missing) or bool(reset_reasons) \
+        or bool(demoted)
+    cause = decide_cause(expired, demoted, reset_reasons, missing)
+    yield (STEP_ADVANCE, cause, removalish)
+    j.update(advanced=True, cause=cause, removalish=removalish)
+    return j
+
+
+def outage_recovery_steps(lease_timeout: float):
+    """Steps on the first successful fetch after a store outage: workers
+    could not renew through it (their pushes go to the same store), so
+    the judgment clock restarts — every lease gets one full timeout to
+    show life before it may expire.  Dropping this re-grace is exactly
+    the seeded ``regrace_dropped`` mutant: a restarted store's replayed
+    leases read as instantly expired and a live worker is shed."""
+    now = yield (STEP_CLOCK,)
+    yield (STEP_GRACE, now + lease_timeout)
+
+
+def recover_steps(lease_timeout: float):
+    """Driver crash-recovery judgment: re-adopt the durable epoch and
+    the live-leased identities whose slot entry holds a rank AT that
+    epoch, then re-grace (replayed lease values are pre-crash).  Returns
+    None when no prior state exists, else ``{"epoch", "adopted"}`` with
+    ``adopted`` mapping identity -> (slot dict, lease value).  The
+    checker proves the adopted epoch equals the journal-replayed one
+    exactly — never 0, never a stale predecessor."""
+    res = yield (STEP_TXN, (("get", DRIVER_SCOPE, "epoch"),),
+                 "recover_epoch")
+    raw = res[0]
+    if raw is None:
+        return None
+    epoch = int(bytes(raw).decode())
+    leased = (yield (STEP_TXN, (("keys", LEASE_SCOPE),),
+                     "recover_lease_keys"))[0]
+    fetch_ops: List[tuple] = []
+    for identity in leased:
+        fetch_ops.append(("get", LEASE_SCOPE, identity))
+        fetch_ops.append(("get", rendezvous_client.RANK_AND_SIZE_SCOPE,
+                          identity))
+    fetched: List[object] = []
+    if fetch_ops:
+        fetched = yield (STEP_TXN, tuple(fetch_ops), "recover_slots")
+    adopted: Dict[str, Tuple[dict, object]] = {}
+    for i, identity in enumerate(leased):
+        lease, slot_raw = fetched[2 * i], fetched[2 * i + 1]
+        if lease is None or slot_raw is None:
+            continue
+        try:
+            slot = json.loads(bytes(slot_raw).decode())
+        except ValueError:
+            continue
+        if slot.get("rank", -1) < 0 or slot.get("epoch", -1) != epoch:
+            continue
+        adopted[identity] = (slot, lease)
+    now = yield (STEP_CLOCK,)
+    yield (STEP_GRACE, now + lease_timeout)
+    return {"epoch": epoch, "adopted": adopted}
 
 
 class ElasticDriver:
@@ -210,7 +493,7 @@ class ElasticDriver:
             # same group so a restarted driver re-adopts this epoch
             # instead of resetting to 0 and respawning the world.
             publish_ops = [
-                ("set", "rank_and_size", identity,
+                ("set", RANK_AND_SIZE_SCOPE, identity,
                  json.dumps(slot).encode())
                 for identity, slot in table.items()]
             publish_ops.append(("set", DRIVER_SCOPE, "epoch",
@@ -239,7 +522,7 @@ class ElasticDriver:
                             "driver", "DRV_SPAWN", t_spawn,
                             identity=identity, epoch=self.epoch)
                     self._exited_identities.discard(identity)
-                    ack_ops.append(("set", "epoch_ack", identity,
+                    ack_ops.append(("set", EPOCH_ACK_SCOPE, identity,
                                     str(self.epoch).encode()))
                 self._known_identities[identity] = s
             if ack_ops:
@@ -310,95 +593,143 @@ class ElasticDriver:
         try:
             fetched = self._tick_store_reads()
             self._renotify_unacked(fetched.get("epoch_ack"))
-            reset_reasons = self._pending_reset_requests(fetched["reset"])
-            demotion_reports = self._parse_demotion_reports(
-                fetched["demotion"], self.epoch)
-            expired = self._scan_leases(fetched["lease"])
             self._store_recovered()
             self._push_driver_metrics()
         except self._STORE_ERRORS as e:
             self._store_outage(e)
             return
-        # Demotions blacklist BEFORE the discovery poll so the shed host
-        # drops out of this very tick's host set (changed + removal).
-        demoted = self._apply_demotions(demotion_reports)
-        try:
-            changed, removal = self.hosts.update_available_hosts()
-        except Exception as e:  # noqa: BLE001 — discovery script hiccups
-            log.warning("host discovery failed: %s", e)
-            return
-        # Identities that should have a process but whose worker died
-        # (without the host being blacklisted) need a respawn epoch.
+        # Drive the pure judgment kernel (model-checked by ``hvd-mck
+        # proto``) against the live host manager and clock.  The
+        # orderings — blacklist-before-poll, expire-before-missing,
+        # gates-before-advance — live in :func:`tick_judgment_steps`;
+        # this loop only executes its steps.
         with self._lock:
-            if self._success:
-                # Winding down: never rendezvous a new epoch once a
-                # worker finished — a fresh slot table would assign a
-                # rank to the dead-but-successful identity and hang the
-                # survivors' mesh build.
-                return
-            if expired:
-                # A lease expired with the store REACHABLE: the worker
-                # is genuinely dead (or wedged past saving) — drop it
-                # from the known set so the missing-workers path below
-                # advances the epoch THIS tick.
-                metrics.inc("lease_expirations_total", len(expired))
-                for identity in sorted(expired):
-                    log.warning(
-                        "worker %s lease expired (no renewal in %.0fs "
-                        "with the store reachable); declaring dead",
-                        identity, self.lease_timeout)
-                    self._known_identities.pop(identity, None)
-                    self._lease_seen.pop(identity, None)
-            missing_workers = {
-                f"{s.hostname}:{s.local_rank}" for s in self._slots
-            } - set(self._known_identities)
-        if not changed and not missing_workers and not reset_reasons \
-                and not demoted:
+            rank_to_host = {s.rank: s.hostname for s in self._slots}
+            slot_identities = {f"{s.hostname}:{s.local_rank}"
+                               for s in self._slots}
+            known = set(self._known_identities)
+        steps = tick_judgment_steps(
+            self.epoch, fetched, rank_to_host, known, slot_identities,
+            self._lease_seen, self._lease_grace_until, self.lease_timeout)
+        resp = None
+        while True:
+            try:
+                step = steps.send(resp)
+            except StopIteration as fin:
+                j = fin.value
+                break
+            kind = step[0]
+            resp = None
+            if kind == STEP_CLOCK:
+                resp = time.monotonic()
+            elif kind == STEP_BLACKLIST:
+                self._blacklist_for_demotion(step[1], step[2])
+            elif kind == STEP_POLL_HOSTS:
+                try:
+                    resp = self.hosts.update_available_hosts()
+                except Exception as e:  # noqa: BLE001 — discovery
+                    # script hiccups must not kill the judgment loop
+                    log.warning("host discovery failed: %s", e)
+                    steps.close()
+                    return
+            elif kind == STEP_GATE:
+                resp = self._judgment_gate(step[1])
+            elif kind == STEP_EXPIRE:
+                self._expire_identity(step[1])
+            # STEP_ADVANCE needs no in-loop action: it is the last yield,
+            # and the advance below consumes the returned judgment.
+        for rank in j["unresolvable"]:
+            log.warning("demotion report for rank %s names no "
+                        "resolvable host; ignoring", rank)
+        if metrics.ENABLED:
+            metrics.set_gauge("leases_live", j["leases_live"])
+            if j["min_ttl"] is not None:
+                metrics.set_gauge("lease_min_ttl_seconds", j["min_ttl"])
+        if j["expired"]:
+            metrics.inc("lease_expirations_total", len(j["expired"]))
+        if not j["advanced"]:
             return
-        if self.reset_limit is not None and \
-                self.resets >= self.reset_limit:
-            msg = (f"elastic reset limit {self.reset_limit} reached; "
-                   "stopping job (reference RESET_LIMIT_EXCEEDED)")
-            log.error(msg)
-            self.stop(error_message=msg)
-            return
-        if self.hosts.total_slots() < self.min_np:
-            log.warning("host change leaves fewer than min_np slots; "
-                        "waiting for capacity")
-            return
-        # A worker-initiated reset (e.g. corruption abort with every
-        # process still alive) is removal-LIKE for sync purposes: the
-        # workers rolled back and must state.sync() after the reset.
-        removalish = removal or bool(missing_workers) \
-            or bool(reset_reasons) or bool(demoted)
-        # Cause precedence mirrors the judgment order above: an expired
-        # lease explains the missing worker it produced, a demotion is a
-        # deliberate shed of a live-but-slow host, a reset request means
-        # everyone is alive, worker_exit is a death the exit monitor saw
-        # first, host_change is pure discovery movement.
-        cause = ("lease_expiry" if expired else
-                 "demotion" if demoted else
-                 "reset_request" if reset_reasons else
-                 "worker_exit" if missing_workers else "host_change")
+        cause, removalish = j["cause"], j["removalish"]
+        missing_workers = j["missing"]
         log.info("host set changed (removal=%s, dead_workers=%s, "
                  "reset_requests=%s, demotions=%s, cause=%s); "
                  "advancing epoch",
-                 removal, sorted(missing_workers), reset_reasons, demoted,
-                 cause)
+                 j["removal"], sorted(missing_workers), j["reset_reasons"],
+                 j["demoted"], cause)
         self._rendezvous_epoch()
         self._await_ack = not removalish  # remember flavor for re-notify
         self._notify_workers(added_only=not removalish)
         metrics.inc("driver_epoch_transitions_total", cause=cause)
         flight_recorder.record(
             "epoch_transition", epoch=self.epoch, cause=cause,
-            removal=removal, dead_workers=sorted(missing_workers),
-            reset_requests=reset_reasons, demotions=demoted)
+            removal=j["removal"], dead_workers=sorted(missing_workers),
+            reset_requests=j["reset_reasons"], demotions=j["demoted"])
         if timeline_mod.control_active():
             timeline_mod.control_span_since(
                 "driver", "CHURN_EVENT", t0_ns,
                 epoch=self.epoch, cause=cause)
             timeline_mod.control_instant(
                 "driver", "EPOCH_TRANSITION", epoch=self.epoch, cause=cause)
+
+    def _judgment_gate(self, which: str) -> bool:
+        """Answer one STEP_GATE: True blocks this tick's advance."""
+        if which == "success":
+            with self._lock:
+                return self._success
+        if which == "reset_limit":
+            if self.reset_limit is not None and \
+                    self.resets >= self.reset_limit:
+                msg = (f"elastic reset limit {self.reset_limit} reached; "
+                       "stopping job (reference RESET_LIMIT_EXCEEDED)")
+                log.error(msg)
+                self.stop(error_message=msg)
+                return True
+            return False
+        # capacity
+        if self.hosts.total_slots() < self.min_np:
+            log.warning("host change leaves fewer than min_np slots; "
+                        "waiting for capacity")
+            return True
+        return False
+
+    def _expire_identity(self, identity: str) -> None:
+        """Execute one STEP_EXPIRE: drop a dead-leased identity so the
+        missing-workers path advances the epoch this tick."""
+        log.warning("worker %s lease expired (no renewal in %.0fs "
+                    "with the store reachable); declaring dead",
+                    identity, self.lease_timeout)
+        with self._lock:
+            self._known_identities.pop(identity, None)
+            self._lease_seen.pop(identity, None)
+
+    def _blacklist_for_demotion(self, host: str,
+                                rep: Dict[str, object]) -> None:
+        """Execute one STEP_BLACKLIST: shed the demoted host and record
+        the evidence (idempotent per (reporter, epoch, rank) — repeated
+        reports still drive the advance but stack no cooldown strike and
+        re-count no metrics)."""
+        rank = rep["rank"]
+        evidence = (f"rank {rank} readiness-lag EWMA {rep.get('ewma')}s "
+                    f"over demote threshold {rep.get('threshold')}s for "
+                    f"{rep.get('cycles')} consecutive busy cycles")
+        new_strike = self.hosts.blacklist(host, evidence=evidence)
+        key = (str(rep.get("reporter")), self.epoch, rank)
+        if key not in self._demotion_seen:
+            self._demotion_seen.add(key)
+            metrics.inc("straggler_demotions_total",
+                        rank=str(rank), host=host)
+            posted = rep.get("posted_unix")
+            if isinstance(posted, (int, float)):
+                # Wall-clock across processes (coordinator vs driver):
+                # same-host skew is negligible against the multi-tick
+                # latencies this histogram bounds.
+                metrics.observe("demotion_latency_seconds",
+                                max(0.0, time.time() - posted))
+            flight_recorder.record(
+                "demotion", epoch=self.epoch, rank=rank, host=host,
+                ewma=rep.get("ewma"), new_strike=new_strike,
+                reporter=rep.get("reporter"))
+            log.warning("demoting host %s: %s", host, evidence)
 
     def _tick_store_reads(self) -> Dict[str, Optional[Dict[str, object]]]:
         """Coalesce this tick's store reads into ONE batched round-trip.
@@ -414,33 +745,24 @@ class ElasticDriver:
         with self._lock:
             slot_ids = sorted({f"{s.hostname}:{s.local_rank}"
                                for s in self._slots})
-            ack_ids = None
-            if self._await_ack is not None and self.epoch != 0:
-                ids = set(slot_ids) | self._removed_identities
-                ids -= self._exited_identities
-                ack_ids = sorted(ids)
-        ops: List[tuple] = []
-        if ack_ids is not None:
-            ops.extend(("get", "epoch_ack", i) for i in ack_ids)
-        ops.extend(("get", rendezvous_client.RESET_REQUEST_SCOPE, i)
-                   for i in slot_ids)
-        ops.extend(("get", rendezvous_client.DEMOTION_REPORT_SCOPE, i)
-                   for i in slot_ids)
-        ops.extend(("get", LEASE_SCOPE, i) for i in slot_ids)
-        results = self.rendezvous.batch(ops)
-        idx = 0
-        out: Dict[str, Optional[Dict[str, object]]] = {"epoch_ack": None}
-        if ack_ids is not None:
-            out["epoch_ack"] = dict(
-                zip(ack_ids, results[idx:idx + len(ack_ids)]))
-            idx += len(ack_ids)
-        out["reset"] = dict(zip(slot_ids, results[idx:idx + len(slot_ids)]))
-        idx += len(slot_ids)
-        out["demotion"] = dict(
-            zip(slot_ids, results[idx:idx + len(slot_ids)]))
-        idx += len(slot_ids)
-        out["lease"] = dict(zip(slot_ids, results[idx:]))
-        return out
+            removed = set(self._removed_identities)
+            exited = set(self._exited_identities)
+            await_ack = self._await_ack
+        return self._drive_txn_steps(tick_read_steps(
+            self.epoch, await_ack, slot_ids, removed, exited))
+
+    def _drive_txn_steps(self, steps):
+        """Execute a kernel generator whose only step kind is STEP_TXN,
+        answering each with one batched store round-trip.  Store errors
+        propagate to the caller (the tick's partitioned-mode handler)."""
+        resp = None
+        while True:
+            try:
+                step = steps.send(resp)
+            except StopIteration as fin:
+                return fin.value
+            assert step[0] == STEP_TXN, step
+            resp = self.rendezvous.batch(list(step[1]))
 
     def _push_driver_metrics(self) -> None:
         """External-server deployments only: the driver's gauges and
@@ -458,161 +780,16 @@ class ElasticDriver:
         self.rendezvous.set(metrics.METRICS_SCOPE, "driver",
                             json.dumps(snap).encode())
 
-    def _pending_reset_requests(
-            self, raws: Optional[Dict[str, object]] = None) -> List[str]:
-        """Worker-posted epoch-reset requests for the CURRENT epoch.
-
-        The integrity plane's recovery trigger: a corruption abort leaves
-        every worker alive-but-rolled-back, waiting for an epoch that no
-        exit or host change would ever produce.  A request stamped with an
-        OLDER epoch was already answered by a later bump and is ignored —
-        the same staleness rule the abort frames use.  ``raws`` is the
-        tick's batched prefetch (identity -> value); None falls back to
-        per-identity reads."""
-        reasons = []
-        if raws is None:
-            with self._lock:
-                identities = {f"{s.hostname}:{s.local_rank}"
-                              for s in self._slots}
-            raws = {identity: self.rendezvous.get(
-                        rendezvous_client.RESET_REQUEST_SCOPE, identity)
-                    for identity in identities}
-        for identity in sorted(raws):
-            raw = raws[identity]
-            if raw is None:
-                continue
-            try:
-                req = json.loads(raw.decode())
-            except ValueError:
-                continue
-            if req.get("epoch", -1) == self.epoch:
-                reasons.append(
-                    f"{identity}: {req.get('reason', 'unspecified')}")
-        return reasons
-
     @staticmethod
     def _parse_demotion_reports(
             raws: Optional[Dict[str, object]],
             epoch: int) -> List[Dict[str, object]]:
-        """Coordinator-posted demotion reports for the CURRENT epoch.
-
-        Mirrors the reset-request staleness rule: a report stamped with
-        an older epoch was answered by a later bump already (the epoch
-        advance it caused re-evaluated the whole world) and is ignored —
-        stale reports auto-expire, no deletion round-trip needed.
-        Malformed payloads are skipped; this channel is advisory."""
-        reports: List[Dict[str, object]] = []
-        for identity in sorted(raws or {}):
-            raw = raws[identity]
-            if raw is None:
-                continue
-            try:
-                rep = json.loads(bytes(raw).decode())
-            except (ValueError, TypeError):
-                continue
-            if isinstance(rep, dict) and rep.get("epoch", -1) == epoch \
-                    and isinstance(rep.get("rank"), int):
-                rep["reporter"] = identity
-                reports.append(rep)
-        return reports
-
-    def _apply_demotions(
-            self, reports: List[Dict[str, object]]) -> List[str]:
-        """Blacklist the hosts named by current-epoch demotion reports.
-
-        The victim's hostname is resolved authoritatively from the
-        driver's own slot table by rank (the report's hostname field is
-        best-effort evidence).  Returns ``rank@host`` strings for the
-        demotions applied this tick — they drive the epoch advance and
-        its ``cause="demotion"`` trail.  Repeated reports for a host
-        already blacklisted still count as a demotion in flight (the
-        epoch must advance) but stack no cooldown strike
-        (``HostManager.blacklist`` idempotency)."""
-        applied: List[str] = []
-        for rep in reports:
-            rank = rep["rank"]
-            with self._lock:
-                host = next((s.hostname for s in self._slots
-                             if s.rank == rank), None)
-            host = host or rep.get("hostname")
-            if not isinstance(host, str) or not host:
-                log.warning("demotion report for rank %s names no "
-                            "resolvable host; ignoring", rank)
-                continue
-            evidence = (f"rank {rank} readiness-lag EWMA {rep.get('ewma')}s "
-                        f"over demote threshold {rep.get('threshold')}s for "
-                        f"{rep.get('cycles')} consecutive busy cycles")
-            new_strike = self.hosts.blacklist(host, evidence=evidence)
-            key = (str(rep.get("reporter")), self.epoch, rank)
-            if key not in self._demotion_seen:
-                self._demotion_seen.add(key)
-                metrics.inc("straggler_demotions_total",
-                            rank=str(rank), host=host)
-                posted = rep.get("posted_unix")
-                if isinstance(posted, (int, float)):
-                    # Wall-clock across processes (coordinator vs
-                    # driver): same-host skew is negligible against the
-                    # multi-tick latencies this histogram bounds.
-                    metrics.observe("demotion_latency_seconds",
-                                    max(0.0, time.time() - posted))
-                flight_recorder.record(
-                    "demotion", epoch=self.epoch, rank=rank, host=host,
-                    ewma=rep.get("ewma"), new_strike=new_strike,
-                    reporter=rep.get("reporter"))
-                log.warning("demoting host %s: %s", host, evidence)
-            applied.append(f"rank {rank}@{host}")
-        return applied
+        """Thin delegate kept for callers/tests; the logic lives in the
+        module-level :func:`parse_demotion_reports` so the judgment
+        kernel and the checker share it."""
+        return parse_demotion_reports(raws, epoch)
 
     # -- lease liveness / store outage (docs/control_plane.md) ---------
-
-    def _scan_leases(
-            self, raws: Optional[Dict[str, object]] = None) -> Set[str]:
-        """Identities whose lease EXPIRED while the store was reachable.
-
-        Identities that never posted a lease are exempt (metrics pushes
-        disabled, or a pre-survivability worker) — exit-watching still
-        covers those.  Raises the store error on outage: the caller's
-        partitioned mode is the only place that decides what that means.
-        ``raws`` is the tick's batched prefetch (every slot identity,
-        None where no lease exists — same exemption); None falls back to
-        the keys-then-get scan."""
-        now = time.monotonic()
-        if raws is None:
-            with self._lock:
-                identities = {f"{s.hostname}:{s.local_rank}"
-                              for s in self._slots}
-            leased = set(self.rendezvous.keys(LEASE_SCOPE))
-            raws = {identity: self.rendezvous.get(LEASE_SCOPE, identity)
-                    for identity in identities & leased}
-        else:
-            identities = set(raws)
-        expired: Set[str] = set()
-        min_ttl: Optional[float] = None
-        for identity in sorted(raws):
-            raw = raws[identity]
-            if raw is None:
-                continue
-            seen = self._lease_seen.get(identity)
-            if seen is None or seen[0] != raw:
-                self._lease_seen[identity] = (raw, now)
-                ttl = self.lease_timeout  # fresh renewal: full budget
-            else:
-                ttl = self.lease_timeout - (now - seen[1])
-                if now >= self._lease_grace_until and \
-                        now - seen[1] > self.lease_timeout:
-                    expired.add(identity)
-            if min_ttl is None or ttl < min_ttl:
-                min_ttl = ttl
-        # Drop tracking for identities that left the slot table.
-        for identity in list(self._lease_seen):
-            if identity not in identities:
-                del self._lease_seen[identity]
-        if metrics.ENABLED:
-            metrics.set_gauge("leases_live",
-                              len(self._lease_seen) - len(expired))
-            if min_ttl is not None:
-                metrics.set_gauge("lease_min_ttl_seconds", min_ttl)
-        return expired
 
     def _store_outage(self, err: Exception) -> None:
         if self._store_outage_since is None:
@@ -628,8 +805,20 @@ class ElasticDriver:
         self._store_outage_since = None
         # Workers could not renew through the outage (their pushes go to
         # the same store); restart the judgment clock so a restarted
-        # server's replayed leases don't read as instantly expired.
-        self._lease_grace_until = time.monotonic() + self.lease_timeout
+        # server's replayed leases don't read as instantly expired.  The
+        # re-grace decision is the kernel's (checked: regrace_dropped).
+        steps = outage_recovery_steps(self.lease_timeout)
+        resp = None
+        while True:
+            try:
+                step = steps.send(resp)
+            except StopIteration:
+                break
+            resp = None
+            if step[0] == STEP_CLOCK:
+                resp = time.monotonic()
+            elif step[0] == STEP_GRACE:
+                self._lease_grace_until = step[1]
         log.info("rendezvous store reachable again after %.1fs outage; "
                  "lease clocks re-graced for %.0fs", outage,
                  self.lease_timeout)
@@ -642,51 +831,48 @@ class ElasticDriver:
         leases of workers whose slot entry holds a rank at that epoch, so
         ``start()`` republishes the SAME epoch and spawns only identities
         with no surviving worker — instead of resetting to epoch 0 and
-        respawning the world.  Returns True when prior state was found."""
+        respawning the world.  Returns True when prior state was found.
+
+        The adoption judgment (which epoch, which identities) is the
+        kernel's :func:`recover_steps` — the checker proves the adopted
+        epoch equals the journal-replayed one exactly."""
         try:
-            raw = self.rendezvous.get(DRIVER_SCOPE, "epoch")
-            if raw is None:
-                return False
-            self.epoch = int(raw.decode())
-            now = time.monotonic()
-            adopted = []
-            leased = self.rendezvous.keys(LEASE_SCOPE)
-            fetch_ops: List[tuple] = []
-            for identity in leased:
-                fetch_ops.append(("get", LEASE_SCOPE, identity))
-                fetch_ops.append(("get",
-                                  rendezvous_client.RANK_AND_SIZE_SCOPE,
-                                  identity))
-            fetched = self.rendezvous.batch(fetch_ops)
-            for i, identity in enumerate(leased):
-                lease, slot_raw = fetched[2 * i], fetched[2 * i + 1]
-                if lease is None or slot_raw is None:
-                    continue
+            steps = recover_steps(self.lease_timeout)
+            resp = None
+            while True:
                 try:
-                    slot = json.loads(slot_raw.decode())
-                except ValueError:
-                    continue
-                if slot.get("rank", -1) < 0 or \
-                        slot.get("epoch", -1) != self.epoch:
-                    continue
-                info = SlotInfo(
-                    hostname=slot["hostname"], rank=slot["rank"],
-                    local_rank=slot["local_rank"],
-                    cross_rank=slot["cross_rank"], size=slot["size"],
-                    local_size=slot["local_size"],
-                    cross_size=slot["cross_size"])
-                with self._lock:
-                    self._known_identities[identity] = info
-                    self._lease_seen[identity] = (lease, now)
-                adopted.append(identity)
+                    step = steps.send(resp)
+                except StopIteration as fin:
+                    recovered = fin.value
+                    break
+                resp = None
+                if step[0] == STEP_TXN:
+                    resp = self.rendezvous.batch(list(step[1]))
+                elif step[0] == STEP_CLOCK:
+                    resp = time.monotonic()
+                elif step[0] == STEP_GRACE:
+                    self._lease_grace_until = step[1]
         except (self._STORE_ERRORS, ValueError) as e:
             log.warning("driver state recovery failed (%s); starting "
                         "fresh at epoch 0", e)
             return False
-        self._lease_grace_until = time.monotonic() + self.lease_timeout
+        if recovered is None:
+            return False
+        self.epoch = recovered["epoch"]
+        now = time.monotonic()
+        for identity, (slot, lease) in recovered["adopted"].items():
+            info = SlotInfo(
+                hostname=slot["hostname"], rank=slot["rank"],
+                local_rank=slot["local_rank"],
+                cross_rank=slot["cross_rank"], size=slot["size"],
+                local_size=slot["local_size"],
+                cross_size=slot["cross_size"])
+            with self._lock:
+                self._known_identities[identity] = info
+                self._lease_seen[identity] = (lease, now)
         log.info("recovered driver state from store: epoch %d, re-adopted "
                  "live workers %s", self.epoch,
-                 sorted(adopted) or "(none)")
+                 sorted(recovered["adopted"]) or "(none)")
         return True
 
     # ------------------------------------------------------------------
@@ -711,7 +897,7 @@ class ElasticDriver:
                 # nobody listening.
                 identities.update(self._removed_identities)
                 identities -= self._exited_identities
-            acks = {identity: self.rendezvous.get("epoch_ack", identity)
+            acks = {identity: self.rendezvous.get(EPOCH_ACK_SCOPE, identity)
                     for identity in identities}
         unacked = set()
         for identity, raw in acks.items():

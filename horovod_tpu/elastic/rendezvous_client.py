@@ -17,26 +17,76 @@ from ..common import env as env_mod
 from ..common.topology import ProcessTopology
 from ..transport.store import HTTPStoreClient
 
-RANK_AND_SIZE_SCOPE = "rank_and_size"
-#: Worker → driver back-channel: a surviving-but-aborted worker posts
-#: ``{"epoch": N, "reason": ...}`` here to ask for a fresh membership
-#: epoch (see ``request_reset``).  The driver treats a CURRENT-epoch
-#: request like a membership change: advance, publish, notify.
-RESET_REQUEST_SCOPE = "reset_request"
-#: Coordinator → driver demotion channel: the straggler plane's verdict
-#: (``core/controller.py`` DemotionPolicy) posts ``{"epoch": N,
-#: "rank": R, "hostname": ..., "ewma": ..., ...}`` here
-#: (see ``post_demotion_report``).  Like reset requests, the driver
-#: honors a CURRENT-epoch report only — a stale report was answered by a
-#: later epoch bump already — and blacklists the named host before
-#: advancing the epoch (docs/elastic.md "self-healing demotion").
-DEMOTION_REPORT_SCOPE = "demotion_report"
+#: Scope names re-exported from the registry (transport/scopes.py,
+#: HVD010).  RESET_REQUEST_SCOPE is the worker → driver back-channel: a
+#: surviving-but-aborted worker posts ``{"epoch": N, "reason": ...}``
+#: there to ask for a fresh membership epoch (see ``request_reset``); the
+#: driver treats a CURRENT-epoch request like a membership change.
+#: DEMOTION_REPORT_SCOPE is the coordinator → driver demotion channel:
+#: the straggler plane's verdict (``core/controller.py`` DemotionPolicy)
+#: posts ``{"epoch": N, "rank": R, "hostname": ..., "ewma": ...}`` there
+#: (see ``post_demotion_report``); the driver honors a CURRENT-epoch
+#: report only and blacklists the named host before advancing the epoch
+#: (docs/elastic.md "self-healing demotion").
+from ..transport.scopes import (  # noqa: F401  (re-exports)
+    DEMOTION_REPORT_SCOPE,
+    EPOCH_ACK_SCOPE,
+    RANK_AND_SIZE_SCOPE,
+    RESET_REQUEST_SCOPE,
+)
 
 
 def _identity() -> str:
     hostname = env_mod.get_str(env_mod.HOROVOD_HOSTNAME) or "localhost"
     local_rank = env_mod.get_int(env_mod.HOROVOD_LOCAL_RANK, 0)
     return f"{hostname}:{local_rank}"
+
+
+# -- worker-post payload builders (model-checked; see tools/mck proto) ----
+#
+# Every worker → driver post is built by a pure function here, so the
+# production posters below (and core/state.py's lease renewer) and the
+# ``hvd-mck proto`` model workers put byte-identical payloads on the
+# wire.  The staleness rule the driver enforces (current-epoch-only)
+# hinges on these stamps; the checker proves a post carrying an older
+# epoch never advances anything.
+
+def lease_renew_ops(identity: str, rank: int, epoch: int, renewals: int,
+                    snap_json: bytes):
+    """The metrics-snapshot + lease-renewal pair that rides one batched
+    transaction each push period.  The lease VALUE must change every
+    renewal (the driver judges freshness by value-change time on its own
+    clock, never by worker clocks) — ``renewals`` is that changing
+    component."""
+    from ..core import metrics
+    from ..transport.store import LEASE_SCOPE
+
+    lease = json.dumps({"rank": rank, "epoch": epoch,
+                        "renewals": renewals}).encode()
+    return [("set", metrics.METRICS_SCOPE, f"rank-{rank}", snap_json),
+            ("set", LEASE_SCOPE, identity, lease)]
+
+
+def reset_request_payload(epoch: int, reason: str) -> bytes:
+    """Epoch-stamped reset request; the driver honors the CURRENT epoch
+    only (anything older was answered by a later bump already)."""
+    return json.dumps({"epoch": epoch, "reason": reason[:512]}).encode()
+
+
+def demotion_report_payload(epoch: int, rank: int, hostname, ewma: float,
+                            threshold: float, cycles: int,
+                            posted_unix: float) -> bytes:
+    """Epoch-stamped demotion report carrying the EWMA evidence, so the
+    driver log and flight recorder agree on *why* the host was shed."""
+    return json.dumps({
+        "epoch": epoch,
+        "rank": rank,
+        "hostname": hostname,
+        "ewma": round(ewma, 6),
+        "threshold": threshold,
+        "cycles": cycles,
+        "posted_unix": posted_unix,
+    }).encode()
 
 
 def store_client() -> Optional[HTTPStoreClient]:
@@ -69,8 +119,7 @@ def request_reset(reason: str) -> bool:
     store = store_client()
     if store is None:
         return False
-    payload = json.dumps({"epoch": env_mod.get_epoch(),
-                          "reason": reason[:512]}).encode()
+    payload = reset_request_payload(env_mod.get_epoch(), reason)
     try:
         from ..core import flight_recorder
 
@@ -120,15 +169,9 @@ def post_demotion_report(rank: int, ewma: float, threshold: float,
     store = store_client()
     if store is None:
         return False
-    payload = json.dumps({
-        "epoch": env_mod.get_epoch(),
-        "rank": rank,
-        "hostname": _resolve_hostname(store, rank),
-        "ewma": round(ewma, 6),
-        "threshold": threshold,
-        "cycles": cycles,
-        "posted_unix": time.time(),
-    }).encode()
+    payload = demotion_report_payload(
+        env_mod.get_epoch(), rank, _resolve_hostname(store, rank),
+        ewma, threshold, cycles, time.time())
     try:
         store.set(DEMOTION_REPORT_SCOPE, _identity(), payload)
         return True
@@ -176,7 +219,7 @@ def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
         delay = min(delay * 2.0, 2.0)
 
     # Ack adoption so the driver stops re-notifying this identity.
-    store.set("epoch_ack", _identity(), str(slot["epoch"]).encode())
+    store.set(EPOCH_ACK_SCOPE, _identity(), str(slot["epoch"]).encode())
 
     if slot["rank"] < 0:
         # Host was removed from the job (reference exits the worker).

@@ -16,11 +16,10 @@ from typing import List, Optional
 
 from ..common import env as env_mod
 from ..common.logging_util import get_logger
+from ..transport.scopes import WORKERS_SCOPE  # noqa: F401  (re-export)
 from ..transport.store import Store
 
 log = get_logger("horovod_tpu.elastic.worker")
-
-WORKERS_SCOPE = "workers"
 
 
 class _NotifyHandler(BaseHTTPRequestHandler):

@@ -52,6 +52,7 @@ from ..common import env as env_mod
 from ..common import faults
 from ..core import metrics as metrics_mod
 from ..core import timeline as timeline_mod
+from ..transport.scopes import RANK_AND_SIZE_SCOPE
 from ..transport.store import (
     BATCH_PATH,
     KEYS_PSEUDO_SCOPE,
@@ -59,8 +60,6 @@ from ..transport.store import (
     decode_batch_ops,
     encode_batch_results,
 )
-
-RANK_AND_SIZE_SCOPE = "rank_and_size"
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -53,6 +53,7 @@ from ..elastic.discovery import FixedHosts, HostManager
 from ..elastic.driver import ElasticDriver
 from ..elastic.rendezvous_client import (
     DEMOTION_REPORT_SCOPE,
+    EPOCH_ACK_SCOPE,
     RESET_REQUEST_SCOPE,
 )
 from ..runner.hosts import HostInfo, SlotInfo
@@ -235,7 +236,7 @@ class SimCluster:
         implicitly acked; survivors ack here, as real workers do from
         ``refresh_topology_from_rendezvous``)."""
         for hi in self._host_infos:
-            ops = [("set", "epoch_ack", w.identity, str(epoch).encode())
+            ops = [("set", EPOCH_ACK_SCOPE, w.identity, str(epoch).encode())
                    for w in self._live() if w.hostname == hi.hostname]
             if ops:
                 self._host_clients[hi.hostname].batch(ops)

@@ -102,6 +102,7 @@ class Project:
         self._fault_doc: Optional[str] = None
         self._metric_catalog: Optional[Tuple[str, ...]] = None
         self._metrics_doc: Optional[str] = None
+        self._scope_registry: Optional[Tuple[str, ...]] = None
 
     @property
     def fault_sites(self) -> Tuple[str, ...]:
@@ -164,6 +165,36 @@ class Project:
                             if isinstance(k, ast.Constant)
                             and isinstance(k.value, str))
         return ()
+
+    @property
+    def scope_registry(self) -> Tuple[str, ...]:
+        """Scope-name string values parsed from the AST of
+        transport/scopes.py (every ``*_SCOPE = "..."`` assignment) —
+        parsed, not imported, like :attr:`fault_sites`.  HVD010 uses the
+        VALUES: a registered scope name appearing as a string literal in
+        a scope position anywhere else is a forked wire contract."""
+        if self._scope_registry is None:
+            self._scope_registry = self._parse_scope_registry()
+        return self._scope_registry
+
+    def _parse_scope_registry(self) -> Tuple[str, ...]:
+        path = os.path.join(self.root, "horovod_tpu", "transport",
+                            "scopes.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return ()
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id.endswith("_SCOPE"):
+                        out.append(node.value.value)
+        return tuple(out)
 
     @property
     def metrics_doc(self) -> str:

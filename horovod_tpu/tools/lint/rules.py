@@ -911,6 +911,86 @@ class ShmAccessorDiscipline(Rule):
             or self._OFF_ATTR_RE.search(name) is not None
 
 
+# ---------------------------------------------------------------------------
+# HVD010 — rendezvous scope names come from transport/scopes.py
+# ---------------------------------------------------------------------------
+
+class ScopeNameRegistry(Rule):
+    """A rendezvous scope name is a wire contract between the driver, the
+    workers, and the store server — three parties that never share code
+    at runtime, so a typo reads an empty scope and times out instead of
+    failing loudly.  ``transport/scopes.py`` is the single source of
+    those names; everything else imports the constant.  A registered
+    scope name appearing as a STRING LITERAL in a scope position
+    elsewhere (first argument of a store ``set``/``get``/``delete``/
+    ``keys``/``wait`` call, or the scope slot of a batch op tuple) is a
+    second spelling of the same contract — exactly how ``"epoch_ack"``
+    drifted into three modules before the registry existed.  Re-binding
+    a ``*_SCOPE`` name to a registered value forks it the same way."""
+
+    code = "HVD010"
+    title = "rendezvous scope literal outside transport/scopes.py"
+
+    #: Store-API methods whose FIRST positional argument is a scope,
+    #: mapped to the minimum positional arity of the STORE signature —
+    #: ``set(scope, key, value)`` has 3, ``get(scope, key)`` has 2,
+    #: ``keys(scope)`` has 1.  The arity gate is what keeps a plain dict
+    #: lookup like ``fetched.get("epoch_ack")`` (one arg: a local dict
+    #: key, not a wire scope) out of the rule's blast radius.
+    _SCOPE_CALLS = {
+        "set": 3, "store_set": 3,
+        "get": 2, "delete": 2, "wait": 2,
+        "store_get": 2, "store_delete": 2,
+        "keys": 1, "store_keys": 1,
+    }
+    #: Batch op verbs: ``(verb, scope, key[, value])`` tuples.
+    _BATCH_VERBS = frozenset({"set", "get", "delete", "keys"})
+
+    def check(self, ctx, project):
+        if ctx.rel_path.endswith("transport/scopes.py"):
+            return
+        scopes = frozenset(project.scope_registry)
+        if not scopes:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name in self._SCOPE_CALLS \
+                        and len(node.args) >= self._SCOPE_CALLS[name] \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and node.args[0].value in scopes:
+                    yield self._v(
+                        ctx, node,
+                        f"scope literal {node.args[0].value!r} in "
+                        f"{name}() call: scope names are a wire contract "
+                        "defined once in transport/scopes.py — import "
+                        "the constant instead of re-spelling it")
+            elif isinstance(node, (ast.Tuple, ast.List)) \
+                    and len(node.elts) >= 2 \
+                    and isinstance(node.elts[0], ast.Constant) \
+                    and node.elts[0].value in self._BATCH_VERBS \
+                    and isinstance(node.elts[1], ast.Constant) \
+                    and node.elts[1].value in scopes:
+                yield self._v(
+                    ctx, node,
+                    f"scope literal {node.elts[1].value!r} in batch op "
+                    f"tuple ({node.elts[0].value!r}, ...): import the "
+                    "constant from transport/scopes.py instead of "
+                    "re-spelling the wire contract")
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value in scopes:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id.endswith("_SCOPE"):
+                        yield self._v(
+                            ctx, node,
+                            f"re-binding of scope name {tgt.id} = "
+                            f"{node.value.value!r}: transport/scopes.py "
+                            "is the single source of scope names; "
+                            "import it, don't shadow it")
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     BlockingUnderLock(),
     EnvLiteralOutsideRegistry(),
@@ -921,6 +1001,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     MetricCatalogRule(),
     FrameBitRegistry(),
     ShmAccessorDiscipline(),
+    ScopeNameRegistry(),
 )
 
 RULE_CODES = frozenset(r.code for r in ALL_RULES) | {"HVD000"}

@@ -30,6 +30,12 @@ exhaustive run must kill with a named violation and a minimal
 reproducing schedule.  CI wires all three runs into ci/lint.sh; see
 docs/static_analysis.md for the full invariant list and how to add a
 protocol.
+
+``hvd-mck proto`` (proto_cli.py) is the second protocol under the same
+engine: message-reordering + crash model checking of the elastic epoch
+control plane — the driver's judgment kernels, the store's batched-
+transaction WAL, and the worker-post payload builders, all production
+code driven against a model cluster.
 """
 
 from __future__ import annotations
@@ -135,6 +141,14 @@ def _run_mutants(args, names: List[str]) -> int:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "proto":
+        # The elastic-epoch-protocol mode: message reordering + crash
+        # exploration of the control-plane kernels (proto_cli.py).
+        from .proto_cli import proto_main
+
+        return proto_main(argv[1:])
     args = _parser().parse_args(argv)
     if args.list:
         _print_listing()

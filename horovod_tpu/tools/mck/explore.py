@@ -42,7 +42,8 @@ from typing import Dict, List, Optional, Tuple
 from .model import Execution, Scenario, Violation, unit
 
 
-def _conflict(a: tuple, ta: frozenset, b: tuple, tb: frozenset) -> bool:
+def _conflict(a: tuple, ta: frozenset, b: tuple, tb: frozenset,
+              unit_fn=unit) -> bool:
     """Dependence relation for sleep sets: a shared location with at
     least one writer, or the same scheduling unit — EXCEPT a thread op
     against the thread's OWN flush agent, which commutes: store-buffer
@@ -51,7 +52,7 @@ def _conflict(a: tuple, ta: frozenset, b: tuple, tb: frozenset) -> bool:
     the location rule covers those pairs).  The thread's own futex
     syscalls DO conflict with its flushes — the syscall drain disables
     them — and are caught below via the drained-entry footprint."""
-    if unit(a) == unit(b):
+    if unit_fn(a) == unit_fn(b):
         if {a[0], b[0]} == {"t", "f"}:
             thread_touch = ta if a[0] == "t" else tb
             return ("w", "futex") in thread_touch
@@ -120,18 +121,31 @@ class ExploreResult:
 def explore(scenario: Scenario, model: str, mutation=None,
             bound: Optional[int] = None, max_schedules: int = 50000,
             max_steps: int = 600, collect: bool = False,
-            sleep_sets: bool = True,
-            structural: bool = True) -> ExploreResult:
+            sleep_sets: bool = True, structural: bool = True,
+            execution_factory=None, unit_fn=None) -> ExploreResult:
     """Explore every schedule of ``scenario`` under ``model`` up to
     ``bound`` preemptions.  ``collect`` keeps going after the first
-    violation to gather one counterexample per violation class."""
+    violation to gather one counterexample per violation class.
+
+    ``execution_factory`` / ``unit_fn`` generalize the engine to other
+    execution models (the ``proto`` mode's message-passing cluster): the
+    factory builds a fresh execution duck-typing :class:`Execution`
+    (``enabled_actions`` / ``touches`` / ``step`` / ``final_check`` /
+    ``violation`` / ``steps``), and ``unit_fn`` maps an action to its
+    scheduling unit ("env" actions are preemption-free).  Defaults are
+    the shared-memory model this module was born with."""
     if bound is None:
         bound = scenario.preemptions
+    if unit_fn is None:
+        unit_fn = unit
     res = ExploreResult(scenario, model,
                         getattr(mutation, "name", None), bound)
     started = time.monotonic()
 
     def fresh() -> Execution:
+        if execution_factory is not None:
+            return execution_factory(scenario, model, mutation=mutation,
+                                     max_steps=max_steps)
         return Execution(scenario, model, mutation=mutation,
                          max_steps=max_steps, structural=structural)
 
@@ -148,11 +162,11 @@ def explore(scenario: Scenario, model: str, mutation=None,
             return None
         # Continuation first: finishing the running unit's block keeps
         # preemption-free schedules at the front of the search.
-        cont = [a for a in enabled if unit(a) == last_unit]
-        rest = sorted((a for a in enabled if unit(a) != last_unit),
+        cont = [a for a in enabled if unit_fn(a) == last_unit]
+        rest = sorted((a for a in enabled if unit_fn(a) != last_unit),
                       key=repr)
         return _Frame(cont + rest, sleep, last_unit, preemptions,
-                      frozenset(unit(a) for a in enabled))
+                      frozenset(unit_fn(a) for a in enabled))
 
     def leaf(ex: Execution) -> None:
         res.schedules += 1
@@ -183,7 +197,7 @@ def explore(scenario: Scenario, model: str, mutation=None,
             frame.idx += 1
             if sleep_sets and cand in frame.sleep:
                 continue
-            u = unit(cand)
+            u = unit_fn(cand)
             cost = 1 if (u != "env" and frame.last_unit is not None
                          and u != frame.last_unit
                          and frame.last_unit in frame.enabled_units) else 0
@@ -201,13 +215,13 @@ def explore(scenario: Scenario, model: str, mutation=None,
         child_sleep = {
             b: tb
             for b, tb in list(frame.sleep.items()) + frame.explored
-            if not _conflict(action, touch, b, tb)
+            if not _conflict(action, touch, b, tb, unit_fn)
         } if sleep_sets else {}
         frame.explored.append((action, touch))
         live.step(action)
         prefix.append(action)
-        next_unit = frame.last_unit if unit(action) == "env" \
-            else unit(action)
+        next_unit = frame.last_unit if unit_fn(action) == "env" \
+            else unit_fn(action)
         child = make_frame(live, child_sleep, next_unit,
                            frame.preemptions + cost)
         if child is None:
@@ -224,8 +238,8 @@ def explore(scenario: Scenario, model: str, mutation=None,
 def check(scenario: Scenario, model: str, mutation=None,
           bound: Optional[int] = None, max_schedules: int = 50000,
           max_steps: int = 600, collect: bool = True,
-          sleep_sets: bool = True,
-          structural: bool = True) -> ExploreResult:
+          sleep_sets: bool = True, structural: bool = True,
+          execution_factory=None, unit_fn=None) -> ExploreResult:
     """Explore at the scenario's full preemption bound; on violation,
     re-run at ascending bounds so the reported counterexamples carry the
     minimal number of preemptions that exhibits each class."""
@@ -234,13 +248,16 @@ def check(scenario: Scenario, model: str, mutation=None,
     res = explore(scenario, model, mutation=mutation, bound=bound,
                   max_schedules=max_schedules, max_steps=max_steps,
                   collect=collect, sleep_sets=sleep_sets,
-                  structural=structural)
+                  structural=structural,
+                  execution_factory=execution_factory, unit_fn=unit_fn)
     if res.violations:
         for smaller in range(bound):
             narrow = explore(scenario, model, mutation=mutation,
                              bound=smaller, max_schedules=max_schedules,
                              max_steps=max_steps, collect=collect,
-                             sleep_sets=sleep_sets, structural=structural)
+                             sleep_sets=sleep_sets, structural=structural,
+                             execution_factory=execution_factory,
+                             unit_fn=unit_fn)
             if narrow.violations:
                 for name, viol in narrow.violations.items():
                     res.violations[name] = viol

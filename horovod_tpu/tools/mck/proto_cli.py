@@ -1,0 +1,174 @@
+"""``hvd-mck proto`` — the elastic-epoch-protocol checking mode.
+
+Same exit-code contract as the shm mode: 0 clean, 1 violation (or a
+surviving mutant), 2 for a truncated ``--smoke`` run or an unknown
+scenario/mutation name.  The JSON report shares the shm schema with
+``"mode": "proto"`` (report.py), so CI tooling reads both artifacts the
+same way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from .explore import ExploreResult, check
+from .proto_model import proto_execution_factory, proto_unit
+from .proto_mutations import PROTO_MUTATIONS
+from .proto_scenarios import PROTO_SCENARIOS
+from .report import render_result, summary_line, write_json
+
+
+def _parser() -> argparse.ArgumentParser:
+    par = argparse.ArgumentParser(
+        prog="hvd-mck proto",
+        description="crash/reorder model checker for the elastic epoch "
+                    "protocol (driver judgment, batched-transaction WAL, "
+                    "worker posts) — the production kernels driven "
+                    "against a model cluster")
+    par.add_argument("--scenario", action="append", default=None,
+                     metavar="NAME",
+                     help="scenario to check (repeatable; default: all)")
+    par.add_argument("--preemptions", type=int, default=None,
+                     help="override the per-scenario preemption bound "
+                          "(crashes and clock advances are free)")
+    par.add_argument("--max-schedules", type=int, default=50000,
+                     help="schedule cap per run; hitting it reports the "
+                          "run as TRUNCATED, never as proved")
+    par.add_argument("--max-steps", type=int, default=600,
+                     help="per-schedule action budget")
+    par.add_argument("--mutation", metavar="NAME",
+                     help="run one seeded mutation from the kill suite")
+    par.add_argument("--inject", metavar="NAME",
+                     help="checker-has-teeth guard: run one seeded "
+                          "mutation as a PLAIN check — exit 1 iff the "
+                          "violation is found (the shm lane's weak-mode "
+                          "counterfactual, for this protocol); an exit "
+                          "of 0 means the checker went blind")
+    par.add_argument("--mutants", action="store_true",
+                     help="run the full mutation-kill suite: exit 0 iff "
+                          "every seeded protocol bug is caught")
+    par.add_argument("--smoke", action="store_true",
+                     help="CI gate: all scenarios clean AND complete; "
+                          "exit 2 if any run truncated")
+    par.add_argument("--json", metavar="PATH",
+                     help="write the machine-readable report here")
+    par.add_argument("--list", action="store_true",
+                     help="list scenarios and mutations, then exit")
+    par.add_argument("-q", "--quiet", action="store_true",
+                     help="print only the summary line and violations")
+    return par
+
+
+def _print_listing() -> None:
+    print("proto scenarios:")
+    for scn in PROTO_SCENARIOS.values():
+        print(f"  {scn.name:22s} ticks={scn.ticks} "
+              f"slots={len(scn.slots)} "
+              f"crashes=st:{scn.store_crashes}/drv:{scn.driver_crashes} "
+              f"preemptions<={scn.preemptions}")
+        print(f"           {scn.description}")
+    print("proto mutations (kill suite):")
+    for mut in PROTO_MUTATIONS.values():
+        print(f"  {mut.name:26s} [{mut.role} @ {mut.scenario}] "
+              f"-> {', '.join(sorted(mut.expected))}")
+        print(f"           {mut.description}")
+
+
+def _check(scenario, args, mutation=None) -> ExploreResult:
+    return check(scenario, "proto", mutation=mutation,
+                 bound=args.preemptions,
+                 max_schedules=args.max_schedules,
+                 max_steps=args.max_steps,
+                 execution_factory=proto_execution_factory,
+                 unit_fn=proto_unit)
+
+
+def _run_mutants(args, names: List[str]) -> int:
+    results: List[ExploreResult] = []
+    unkilled: List[str] = []
+    for name in names:
+        mut = PROTO_MUTATIONS[name]
+        res = _check(PROTO_SCENARIOS[mut.scenario], args, mutation=mut)
+        results.append(res)
+        caught = set(res.violations) & mut.expected
+        if caught:
+            if not args.quiet:
+                print(render_result(res))
+                print(f"  KILLED by {', '.join(sorted(caught))}")
+        else:
+            unkilled.append(name)
+            print(render_result(res))
+            found = ", ".join(sorted(res.violations)) or "nothing"
+            print(f"  NOT KILLED: expected one of "
+                  f"{', '.join(sorted(mut.expected))}, found {found}")
+    if args.json:
+        write_json(results, "proto", args.json)
+    print(summary_line(results))
+    if unkilled:
+        print(f"hvd-mck proto: mutation suite FAILED — surviving "
+              f"mutants: {', '.join(unkilled)} (the checker's bounds no "
+              f"longer catch seeded protocol bugs)")
+        return 1
+    print(f"hvd-mck proto: mutation suite passed — "
+          f"{len(names)}/{len(names)} mutants killed")
+    return 0
+
+
+def proto_main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list:
+        _print_listing()
+        return 0
+
+    if args.inject:
+        if args.inject not in PROTO_MUTATIONS:
+            print(f"hvd-mck proto: unknown mutation {args.inject!r} "
+                  f"(have: {', '.join(PROTO_MUTATIONS)})", file=sys.stderr)
+            return 2
+        mut = PROTO_MUTATIONS[args.inject]
+        res = _check(PROTO_SCENARIOS[mut.scenario], args, mutation=mut)
+        print(render_result(res))
+        if args.json:
+            write_json([res], "proto", args.json)
+        print(summary_line([res]))
+        return 1 if res.violations else 0
+
+    if args.mutation or args.mutants:
+        if args.mutation:
+            if args.mutation not in PROTO_MUTATIONS:
+                print(f"hvd-mck proto: unknown mutation "
+                      f"{args.mutation!r} "
+                      f"(have: {', '.join(PROTO_MUTATIONS)})",
+                      file=sys.stderr)
+                return 2
+            names = [args.mutation]
+        else:
+            names = list(PROTO_MUTATIONS)
+        return _run_mutants(args, names)
+
+    names = args.scenario or list(PROTO_SCENARIOS)
+    for name in names:
+        if name not in PROTO_SCENARIOS:
+            print(f"hvd-mck proto: unknown scenario {name!r} "
+                  f"(have: {', '.join(PROTO_SCENARIOS)})",
+                  file=sys.stderr)
+            return 2
+    results = []
+    for name in names:
+        res = _check(PROTO_SCENARIOS[name], args)
+        results.append(res)
+        if not args.quiet or not res.ok:
+            print(render_result(res))
+    if args.json:
+        write_json(results, "proto", args.json)
+    print(summary_line(results))
+    if any(not r.ok for r in results):
+        return 1
+    if args.smoke and any(r.truncated for r in results):
+        print("hvd-mck proto: smoke run truncated — raise "
+              "--max-schedules or shrink the scenario; an incomplete "
+              "exploration is not a proof", file=sys.stderr)
+        return 2
+    return 0

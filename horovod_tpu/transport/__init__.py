@@ -1,4 +1,36 @@
-from .store import HTTPStoreClient, MemoryStore, Store  # noqa: F401
-from .tcp import AbortState, TcpMesh  # noqa: F401
-from .shm import ShmMesh  # noqa: F401
-from .select import LinkMesh, build_link_mesh  # noqa: F401
+"""Transport package: meshes (tcp/shm), the rendezvous store, and the
+scope-name registry.
+
+Re-exports are LAZY (PEP 562): ``transport.scopes`` must stay importable
+from ``core/metrics.py`` without dragging in ``tcp`` → ``core/timeline``
+→ ``core/metrics`` (a cycle).  Eagerly importing the mesh modules here
+would make the registry unusable from anything the timeline depends on.
+"""
+
+_EXPORTS = {
+    "HTTPStoreClient": ("store", "HTTPStoreClient"),
+    "MemoryStore": ("store", "MemoryStore"),
+    "Store": ("store", "Store"),
+    "AbortState": ("tcp", "AbortState"),
+    "TcpMesh": ("tcp", "TcpMesh"),
+    "ShmMesh": ("shm", "ShmMesh"),
+    "LinkMesh": ("select", "LinkMesh"),
+    "build_link_mesh": ("select", "build_link_mesh"),
+}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    value = getattr(importlib.import_module(f".{mod_name}", __name__), attr)
+    globals()[name] = value  # cache: __getattr__ only fires on misses
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

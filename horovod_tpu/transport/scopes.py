@@ -1,0 +1,60 @@
+"""The rendezvous scope-name registry: every key the control plane reads
+or writes lives under one of THESE scopes and no other.
+
+Why a registry instead of per-module constants: a scope name is a wire
+contract between three parties that never share code at runtime — the
+driver (``elastic/driver.py``), the workers (``elastic/rendezvous_client``
+/ ``core/state.py``), and the store server (``transport/store.py``).  A
+typo in any one of them doesn't fail loudly; it reads an empty scope and
+times out.  Centralizing the literals (and lint rule HVD010, which
+rejects scope string literals anywhere else) turns that silent partition
+into an import error or a lint failure.
+
+Grep discipline: modules that historically defined these names keep
+re-exporting them (``from ..transport.scopes import LEASE_SCOPE``) so
+existing import sites stay valid; only the defining assignment moved.
+"""
+
+from __future__ import annotations
+
+#: Driver-private scope: the durable epoch counter lives at
+#: ``(DRIVER_SCOPE, "epoch")`` so ``recover_from_store()`` can re-adopt
+#: it after a driver restart.
+DRIVER_SCOPE = "driver"
+
+#: Driver → worker slot table: ``hostname:local_rank`` → rank/size/epoch
+#: JSON.  Rank −1 means "removed, exit".
+RANK_AND_SIZE_SCOPE = "rank_and_size"
+
+#: Worker → driver adoption ack: each identity posts the epoch it has
+#: adopted so the driver stops re-notifying it.
+EPOCH_ACK_SCOPE = "epoch_ack"
+
+#: Worker → driver liveness: each identity's lease heartbeat payload,
+#: judged by value-change freshness on the driver's monotonic clock.
+LEASE_SCOPE = "lease"
+
+#: Worker → driver reset back-channel: ``{"epoch": N, "reason": ...}``
+#: from a surviving-but-aborted worker (current-epoch requests only).
+RESET_REQUEST_SCOPE = "reset_request"
+
+#: Coordinator → driver straggler verdicts: ``{"epoch": N, "rank": R,
+#: ...}`` from the DemotionPolicy (current-epoch reports only).
+DEMOTION_REPORT_SCOPE = "demotion_report"
+
+#: Launcher bookkeeping: one key per spawned worker process.
+WORKERS_SCOPE = "workers"
+
+#: Worker → driver metrics snapshots, one key per rank.
+METRICS_SCOPE = "metrics"
+
+ALL_SCOPES = (
+    DRIVER_SCOPE,
+    RANK_AND_SIZE_SCOPE,
+    EPOCH_ACK_SCOPE,
+    LEASE_SCOPE,
+    RESET_REQUEST_SCOPE,
+    DEMOTION_REPORT_SCOPE,
+    WORKERS_SCOPE,
+    METRICS_SCOPE,
+)

@@ -24,10 +24,11 @@ from typing import Dict, List, Optional, Tuple
 
 #: Scope workers renew their liveness lease in (``PUT /lease/<identity>``
 #: on the metrics-push cadence); the elastic driver judges dead-vs-
-#: partitioned from it (docs/control_plane.md).  Defined here, at the
-#: store layer, because both the worker pusher (core/state.py) and the
-#: driver (elastic/driver.py) need it without importing each other.
-LEASE_SCOPE = "lease"
+#: partitioned from it (docs/control_plane.md).  Re-exported here for the
+#: worker pusher (core/state.py) and the driver (elastic/driver.py),
+#: which historically imported it from the store layer; the defining
+#: literal lives in the scope registry (transport/scopes.py, HVD010).
+from .scopes import LEASE_SCOPE  # noqa: F401  (re-export)
 
 #: Reserved pseudo-scope for the server's key-enumeration endpoint
 #: (``GET /__keys__/<scope>`` → JSON list); never used as a real scope.
@@ -41,6 +42,103 @@ BATCH_PATH = "/batch"
 
 #: Overlay marker for a key deleted earlier in the same batch.
 _TOMBSTONE = object()
+
+
+# -- batched-transaction kernel (model-checked; see tools/mck proto) ----------
+#
+# The batch-apply + WAL-ordering logic is written ONCE, as a pure
+# generator over an abstract store: every state access is one yielded
+# step tuple, in exact program order, and the caller executes it against
+# the real ``_data`` dict and journal — or, under ``hvd-mck proto``,
+# against a model store whose journal is a byte blob that can be torn at
+# any offset by a modeled crash.  The model-checked code IS the
+# production code; the journal-before-apply ordering and the
+# one-frame-per-group atomicity the checker proves are properties of
+# THIS generator, not of a parallel description that could drift.
+#
+# Step vocabulary (first element is the kind; the driver answers loads
+# and key scans through ``generator.send``):
+#
+#   (STEP_LOAD, flat)             -> Optional[bytes]   read one key
+#   (STEP_KEYS, prefix)           -> List[str]         flat keys w/ prefix
+#   (STEP_JOURNAL, mutations)                          append the group
+#                                    record (one frame) BEFORE any apply
+#   (STEP_APPLY, flat, value)                          apply one mutation
+#                                    (value None = delete)
+#   (STEP_NOTIFY,)                                     wake blocked waiters
+#   (STEP_REPLY, results)                              the ack point: after
+#                                    this step the caller has promised the
+#                                    results (durability must already hold)
+#
+# The generator returns the results list.
+
+STEP_LOAD = "load"
+STEP_KEYS = "keys"
+STEP_JOURNAL = "journal_append"
+STEP_APPLY = "store_apply"
+STEP_NOTIFY = "notify"
+STEP_REPLY = "reply"
+
+
+def batch_steps(ops: List[tuple]):
+    """Evaluate one ordered batch: stage mutations in an overlay (so
+    later ops read their writes), then journal the WHOLE group as one
+    record, then apply — journal strictly before the first apply, reply
+    strictly after the last.  Crash-at-any-yield plus truncating replay
+    keeps this atomic: the group frame either fully made the journal (all
+    mutations replay) or it didn't (none do); there is no prefix."""
+    from .journal import OP_DELETE, OP_SET
+
+    overlay: Dict[str, object] = {}
+    mutations: List[Tuple[int, str, bytes]] = []
+    results: List[object] = []
+    any_set = False
+    for op in ops:
+        kind = op[0]
+        if kind == "set":
+            _, scope, key, value = op
+            flat = f"{scope}/{key}"
+            overlay[flat] = value
+            mutations.append((OP_SET, flat, value))
+            results.append(True)
+            any_set = True
+        elif kind == "get":
+            flat = f"{op[1]}/{op[2]}"
+            if flat in overlay:
+                v = overlay[flat]
+                results.append(None if v is _TOMBSTONE else v)
+            else:
+                results.append((yield (STEP_LOAD, flat)))
+        elif kind == "delete":
+            flat = f"{op[1]}/{op[2]}"
+            if flat in overlay:
+                existed = overlay[flat] is not _TOMBSTONE
+            else:
+                existed = (yield (STEP_LOAD, flat)) is not None
+            if existed:  # no journal record for a no-op delete
+                overlay[flat] = _TOMBSTONE
+                mutations.append((OP_DELETE, flat, b""))
+            results.append(existed)
+        elif kind == "keys":
+            prefix = f"{op[1]}/"
+            base = yield (STEP_KEYS, prefix)
+            names = {k[len(prefix):] for k in base}
+            for flat, v in overlay.items():
+                if flat.startswith(prefix):
+                    if v is _TOMBSTONE:
+                        names.discard(flat[len(prefix):])
+                    else:
+                        names.add(flat[len(prefix):])
+            results.append(sorted(names))
+        else:
+            raise ValueError(f"unknown batch op {kind!r}")
+    yield (STEP_JOURNAL, tuple(mutations))
+    for flat, v in overlay.items():
+        yield (STEP_APPLY, flat, None if v is _TOMBSTONE else v)
+    if any_set:
+        yield (STEP_NOTIFY,)
+    yield (STEP_REPLY, tuple(results))
+    return results
 
 
 # -- batch wire codec (shared with runner/rendezvous.py's /batch handler;
@@ -268,64 +366,44 @@ class MemoryStore(Store):
         applied — or journaled — until every op has been evaluated, so
         the journal group matches exactly what the memory apply does.
         WAL ordering holds batch-wide: the group record is (fsync'd and)
-        written before the first byte of the overlay lands in ``_data``."""
-        from .journal import OP_DELETE, OP_SET
+        written before the first byte of the overlay lands in ``_data``.
 
+        The op evaluation and ordering live in the pure
+        :func:`batch_steps` kernel (model-checked by ``hvd-mck proto``);
+        this method is the production driver executing its steps against
+        the live dict and journal under one lock acquisition."""
         self._acquire()
         try:
             data = self._data
-            overlay: Dict[str, object] = {}
-            mutations: List[Tuple[int, str, bytes]] = []
-            results: List[object] = []
-
-            def current(flat: str):
-                if flat in overlay:
-                    v = overlay[flat]
-                    return None if v is _TOMBSTONE else v
-                return data.get(flat)
-
-            any_set = False
-            for op in ops:
-                kind = op[0]
-                if kind == "set":
-                    _, scope, key, value = op
-                    flat = f"{scope}/{key}"
-                    overlay[flat] = value
-                    mutations.append((OP_SET, flat, value))
-                    results.append(True)
-                    any_set = True
-                elif kind == "get":
-                    results.append(current(f"{op[1]}/{op[2]}"))
-                elif kind == "delete":
-                    flat = f"{op[1]}/{op[2]}"
-                    existed = current(flat) is not None
-                    if existed:  # no journal record for a no-op delete
-                        overlay[flat] = _TOMBSTONE
-                        mutations.append((OP_DELETE, flat, b""))
-                    results.append(existed)
-                elif kind == "keys":
-                    prefix = f"{op[1]}/"
-                    names = {k[len(prefix):] for k in data
-                             if k.startswith(prefix)}
-                    for flat, v in overlay.items():
-                        if flat.startswith(prefix):
-                            if v is _TOMBSTONE:
-                                names.discard(flat[len(prefix):])
-                            else:
-                                names.add(flat[len(prefix):])
-                    results.append(sorted(names))
-                else:
-                    raise ValueError(f"unknown batch op {kind!r}")
-            self._journal_group(mutations)
-            for flat, v in overlay.items():
-                if v is _TOMBSTONE:
-                    data.pop(flat, None)
-                else:
-                    data[flat] = v
-            if any_set:
-                self._cv.notify_all()
+            steps = batch_steps(ops)
+            resp = None
+            while True:
+                try:
+                    step = steps.send(resp)
+                except StopIteration as fin:
+                    results = fin.value
+                    break
+                kind = step[0]
+                resp = None
+                if kind == STEP_LOAD:
+                    resp = data.get(step[1])
+                elif kind == STEP_KEYS:
+                    prefix = step[1]
+                    resp = [k for k in data if k.startswith(prefix)]
+                elif kind == STEP_JOURNAL:
+                    self._journal_group(list(step[1]))
+                elif kind == STEP_APPLY:
+                    _, flat, v = step
+                    if v is None:
+                        data.pop(flat, None)
+                    else:
+                        data[flat] = v
+                elif kind == STEP_NOTIFY:
+                    self._cv.notify_all()
+                # STEP_REPLY needs no action here: returning below IS the
+                # reply, and it already follows journal + apply.
             self._after_batch_locked()
-            return results
+            return list(results)
         finally:
             self._cv.release()
 

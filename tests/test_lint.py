@@ -928,6 +928,82 @@ def test_no_anonymous_threads_in_tree(tree_violations):
     assert [v for v in tree_violations if v.code == "HVD006"] == []
 
 
+# ---------------------------------------------------------------------------
+# HVD010 — rendezvous scope names come from transport/scopes.py
+# ---------------------------------------------------------------------------
+
+SCOPES_PATH = os.path.join(PKG, "transport", "scopes.py")
+
+HVD010_VIOLATING = """
+    def renew(store, identity, payload):
+        store.set("lease", identity, payload)
+"""
+
+HVD010_BATCH_VIOLATING = """
+    def publish(store, identity, blob):
+        store.batch([("set", "rank_and_size", identity, blob)])
+"""
+
+HVD010_REBIND = """
+    LEASE_SCOPE = "lease"
+"""
+
+HVD010_CLEAN = """
+    from horovod_tpu.transport.scopes import LEASE_SCOPE
+    def renew(store, identity, payload):
+        store.set(LEASE_SCOPE, identity, payload)
+    def local_lookup(fetched):
+        return fetched.get("epoch_ack")      # dict key, not a wire scope
+    def own_namespace(store, key):
+        return store.get("myapp_private", key)   # unregistered scope
+"""
+
+HVD010_SUPPRESSED = """
+    def renew(store, identity, payload):
+        store.set("lease", identity, payload)  # hvdlint: disable=HVD010 -- fixture: testing the suppression path
+"""
+
+
+def test_hvd010_call_literal():
+    vs = run(HVD010_VIOLATING)
+    assert codes(vs) == ["HVD010"]
+    assert "scopes.py" in vs[0].message
+
+
+def test_hvd010_batch_tuple_literal():
+    vs = run(HVD010_BATCH_VIOLATING)
+    assert codes(vs) == ["HVD010"]
+    assert "rank_and_size" in vs[0].message
+
+
+def test_hvd010_registry_name_rebind():
+    vs = run(HVD010_REBIND)
+    assert codes(vs) == ["HVD010"]
+    assert "LEASE_SCOPE" in vs[0].message
+
+
+def test_hvd010_clean():
+    assert run(HVD010_CLEAN) == []
+
+
+def test_hvd010_suppressed():
+    assert run(HVD010_SUPPRESSED) == []
+
+
+def test_hvd010_scoped_out_of_scopes_registry():
+    # The registry file itself is where the literals belong.
+    vs = run(HVD010_REBIND, path=SCOPES_PATH)
+    assert [v for v in vs if v.code == "HVD010"] == []
+
+
+def test_hvd010_registry_parsed_not_imported():
+    # The project parses scope VALUES out of transport/scopes.py's AST;
+    # the wire names the control plane depends on must all be there.
+    scopes = set(PROJECT.scope_registry)
+    assert {"lease", "rank_and_size", "epoch_ack", "reset_request",
+            "demotion_report", "driver", "metrics"} <= scopes
+
+
 @pytest.mark.parametrize("code,fixture", [
     ("HVD001", HVD001_WITH),
     ("HVD002", HVD002_VIOLATING),
@@ -937,6 +1013,7 @@ def test_no_anonymous_threads_in_tree(tree_violations):
     ("HVD007", HVD007_VIOLATING),
     ("HVD008", HVD008_VIOLATING),
     ("HVD009", HVD009_VIOLATING),
+    ("HVD010", HVD010_VIOLATING),
 ])
 def test_seeded_violation_fails_with_right_code(tmp_path, code, fixture):
     """Seeding any single violation into a linted tree must fail the pass
@@ -962,4 +1039,4 @@ def test_cli_exit_codes(tmp_path, capsys):
 def test_rule_codes_catalog():
     assert RULE_CODES == {"HVD000", "HVD001", "HVD002", "HVD003",
                           "HVD004", "HVD005", "HVD006", "HVD007",
-                          "HVD008", "HVD009"}
+                          "HVD008", "HVD009", "HVD010"}

@@ -1,0 +1,250 @@
+"""hvd-mck proto: the elastic-protocol checker's acceptance contract.
+
+Mirror of tests/test_mck.py for the second protocol under the engine.
+Five claims, each load-bearing for trusting the elastic control plane:
+
+- **clean and COMPLETE**: every scenario fully explores (never
+  truncated) with zero violations — the deployment claim for the epoch
+  protocol under message reordering, crashes, and clock jumps.
+- **mutants die**: every seeded protocol bug (proto_mutations.py) is
+  killed within the configured bounds by one of its expected violation
+  classes, with a reproducing schedule.
+- **reduction is sound**: the sleep-set footprints (ProtoExecution.
+  touches) prune schedules, never verdicts — a reduced run and an
+  unreduced run agree.
+- **byte-level crashes collapse to frame boundaries**: the journal's
+  longest-valid-prefix replay makes a crash at ANY byte offset recover
+  to a whole-transaction state, which is what lets the torn sweep check
+  frame boundaries and honestly claim every byte.
+- **truncation is honest**: hitting the schedule cap reports incomplete
+  and fails the CI smoke gate — never silently passes as exhaustive.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from horovod_tpu.tools.mck import main  # noqa: E402
+from horovod_tpu.tools.mck.explore import check, explore  # noqa: E402
+from horovod_tpu.tools.mck.proto_model import (  # noqa: E402
+    _replay,
+    proto_execution_factory,
+    proto_unit,
+)
+from horovod_tpu.tools.mck.proto_mutations import PROTO_MUTATIONS  # noqa: E402
+from horovod_tpu.tools.mck.proto_scenarios import PROTO_SCENARIOS  # noqa: E402
+from horovod_tpu.transport.journal import (  # noqa: E402
+    JOURNAL_MAGIC,
+    OP_SET,
+    encode_group,
+    pack_frame,
+)
+
+
+def _explore(name, mutation=None, **kw):
+    return explore(PROTO_SCENARIOS[name], "proto", mutation=mutation,
+                   execution_factory=proto_execution_factory,
+                   unit_fn=proto_unit, **kw)
+
+
+def _check(name, mutation=None, **kw):
+    return check(PROTO_SCENARIOS[name], "proto", mutation=mutation,
+                 execution_factory=proto_execution_factory,
+                 unit_fn=proto_unit, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the deployment claim: clean AND complete on every scenario
+# ---------------------------------------------------------------------------
+
+# The two biggest state spaces (clock-jump scenarios: ~10s each) ride
+# the slow lane; ci/lint.sh's `proto --smoke` still explores every
+# scenario on every CI run, so tier-1 skipping them loses no coverage.
+_SLOW_SCENARIOS = {"lease_expiry", "outage_regrace"}
+
+
+@pytest.mark.parametrize(
+    "name",
+    [pytest.param(n, marks=pytest.mark.slow) if n in _SLOW_SCENARIOS
+     else n for n in sorted(PROTO_SCENARIOS)])
+def test_proto_exhaustive_and_clean(name):
+    res = _check(name)
+    assert res.complete, (
+        f"proto run over {name!r} truncated at {res.schedules} schedules "
+        "— an incomplete exploration is not a proof")
+    assert res.ok, (
+        f"proto violations in {name!r}: "
+        + "; ".join(f"{v.name}: {v.detail}" for v in res.violations.values()))
+
+
+def test_proto_is_deterministic():
+    # Replay-based DFS over the protocol generators must be exactly
+    # reproducible: same scenario, same schedule count, same max depth.
+    a = _explore("driver_crash_recovery")
+    b = _explore("driver_crash_recovery")
+    assert (a.schedules, a.max_depth) == (b.schedules, b.max_depth)
+    assert a.ok and b.ok
+
+
+# ---------------------------------------------------------------------------
+# the reduction's soundness canary
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["txn_crash", "stale_race", "np2_demotion"])
+def test_sleep_sets_prune_schedules_not_verdicts(name):
+    # The per-location footprints (ProtoExecution.touches) are the one
+    # place an UNDER-approximation would silently hide interleavings, so
+    # diff a reduced run against an unreduced one: identical verdicts,
+    # fewer-or-equal schedules.
+    reduced = _explore(name)
+    full = _explore(name, sleep_sets=False)
+    assert sorted(reduced.violations) == sorted(full.violations) == []
+    assert reduced.complete and full.complete
+    assert reduced.schedules <= full.schedules
+
+
+@pytest.mark.parametrize("name", ["txn_crash", "stale_race"])
+def test_mutants_die_without_sleep_sets_too(name):
+    # And the kill verdicts agree as well: a seeded bug found only
+    # thanks to pruning (or only without it) would mean the reduction
+    # changes semantics.
+    muts = [m for m in PROTO_MUTATIONS.values() if m.scenario == name]
+    assert muts
+    for mut in muts:
+        reduced = _explore(name, mutation=mut)
+        full = _explore(name, mutation=mut, sleep_sets=False)
+        assert set(reduced.violations) & mut.expected
+        assert set(full.violations) & mut.expected
+
+
+# ---------------------------------------------------------------------------
+# the mutation-kill suite: the checker's checker
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "name",
+    [pytest.param(n, marks=pytest.mark.slow)
+     if PROTO_MUTATIONS[n].scenario in _SLOW_SCENARIOS else n
+     for n in sorted(PROTO_MUTATIONS)])
+def test_proto_mutation_killed(name):
+    mut = PROTO_MUTATIONS[name]
+    res = _check(mut.scenario, mutation=mut)
+    caught = set(res.violations) & mut.expected
+    assert caught, (
+        f"mutant {name!r} SURVIVED the exhaustive run (expected one of "
+        f"{sorted(mut.expected)}, found {sorted(res.violations)}): the "
+        "configured bounds no longer catch seeded protocol bugs")
+    for cls in caught:
+        assert res.violations[cls].schedule, (
+            f"kill of {name!r} by {cls} carries no reproducing schedule")
+
+
+def test_proto_mutation_suite_is_nontrivial():
+    # At least the ISSUE's five classic control-plane bugs, each on the
+    # side and scenario where it can actually bite.
+    assert {"apply_before_journal", "group_split",
+            "stale_epoch_check_removed", "blacklist_after_poll",
+            "regrace_dropped"} <= set(PROTO_MUTATIONS)
+
+
+# ---------------------------------------------------------------------------
+# byte-level crash points collapse to frame boundaries
+# ---------------------------------------------------------------------------
+
+def test_byte_level_crash_points_collapse_to_frame_boundaries():
+    # The torn sweep checks frame-boundary prefixes but the claim is
+    # per BYTE: a crash may truncate the journal anywhere.  The bridge
+    # is the longest-valid-prefix replay — prove it on a real blob by
+    # replaying every byte prefix and checking each lands exactly on
+    # the nearest preceding whole-frame state, never a half-group.
+    frames = [
+        pack_frame(JOURNAL_MAGIC),
+        pack_frame(encode_group([(OP_SET, "driver/epoch", b"1"),
+                                 (OP_SET, "lease/h0:0", b"{}")])),
+        pack_frame(encode_group([(OP_SET, "driver/epoch", b"2"),
+                                 (OP_SET, "metrics/rank-0", b"x" * 7)])),
+    ]
+    blob = b"".join(frames)
+    boundary_states = []
+    off = 0
+    for frame in frames:
+        off += len(frame)
+        boundary_states.append(_replay(blob[:off]))
+    # Group atomicity: successive boundaries differ by whole
+    # transactions (epoch 1 + lease together, then epoch 2 + metrics).
+    assert boundary_states[1]["driver/epoch"] == b"1"
+    assert "lease/h0:0" in boundary_states[1]
+    assert boundary_states[2]["metrics/rank-0"] == b"x" * 7
+
+    for cut in range(len(blob) + 1):
+        state = _replay(blob[:cut])
+        assert state in [{}] + boundary_states, (
+            f"byte cut at {cut} replays to a state that is no "
+            f"transaction boundary: {state!r}")
+    # And a cut strictly inside the last frame must fall BACK to the
+    # previous boundary (longest VALID prefix, not best-effort parse).
+    mid_last = len(blob) - len(frames[-1]) + 3
+    assert _replay(blob[:mid_last]) == boundary_states[1]
+
+
+# ---------------------------------------------------------------------------
+# truncation honesty + CLI contract
+# ---------------------------------------------------------------------------
+
+def test_truncated_run_is_not_a_proof():
+    res = _explore("tick_posts", max_schedules=3)
+    assert res.truncated and not res.complete
+    assert res.schedules <= 3
+
+
+def test_cli_scenarios_pass_clean(capsys):
+    assert main(["proto", "--scenario", "txn_crash",
+                 "--scenario", "stale_race", "--smoke", "-q"]) == 0
+    out = capsys.readouterr().out
+    assert "no violations" in out
+
+
+def test_cli_inject_finds_the_seeded_bug(capsys):
+    # The lint lane's teeth guard: a seeded bug run as a plain check
+    # must exit 1 — violations found — specifically, not a crash.
+    assert main(["proto", "--inject", "stale_epoch_check_removed",
+                 "-q"]) == 1
+    out = capsys.readouterr().out
+    assert "stale-report-acted" in out
+
+
+def test_cli_single_mutant_killed(capsys):
+    assert main(["proto", "--mutation", "group_split"]) == 0
+    out = capsys.readouterr().out
+    assert "KILLED by torn-group" in out
+
+
+def test_cli_smoke_trips_on_truncation(capsys):
+    assert main(["proto", "--scenario", "tick_posts", "--smoke",
+                 "--max-schedules", "3", "-q"]) == 2
+
+
+def test_cli_unknown_names(capsys):
+    assert main(["proto", "--scenario", "nope"]) == 2
+    assert main(["proto", "--mutation", "nope"]) == 2
+    assert main(["proto", "--inject", "nope"]) == 2
+
+
+def test_cli_json_report(tmp_path, capsys):
+    path = tmp_path / "mck.proto.json"
+    assert main(["proto", "--scenario", "txn_crash", "-q",
+                 "--json", str(path)]) == 0
+    doc = json.loads(path.read_text())
+    assert doc["tool"] == "hvd-mck"
+    assert doc["mode"] == "proto"
+    assert doc["ok"] and doc["complete"]
+    run = doc["runs"][0]
+    assert run["scenario"] == "txn_crash"
+    assert run["complete"] and run["violations"] == []
