@@ -1,0 +1,275 @@
+"""Churn-to-first-step A/B: live reshard vs. the legacy full-teardown
+recovery, under SILENT preemption.
+
+The churn model is a preempted VM, not a crashed process: the victim is
+SIGSTOP'd (its lease stops renewing but its sockets stay OPEN and
+silent) and its host is removed from the discovery pool — exactly what a
+reclaimed TPU VM looks like from the survivors' side.  A plain SIGKILL
+would close the victim's sockets and hand every survivor a prompt EOF,
+which both recovery paths turn into a fast coordinated abort; the
+regime the reshard tentpole exists for is the silent one, where the
+legacy path has nothing to go on until the TCP progress deadline
+expires while the reshard path aborts survivors' in-flight collectives
+within one poll quantum of the driver's lease-expiry judgment.
+
+Both arms run the SAME np=8 job (8 single-slot loopback hosts), the
+SAME kill, the SAME lease timeout and progress deadline; the only
+difference is ``HOROVOD_RESHARD``.  The metric is the longest gap
+between consecutive committed batches on a surviving rank —
+churn-to-first-step as training actually experiences it.  The committed
+deadline here is 60 s to keep the bench runnable; the production
+default is 600 s (``DEFAULT_TCP_PROGRESS_DEADLINE_SECS``), which only
+widens the legacy arm's gap, so the ratio below is a floor.
+
+    python benchmarks/reshard_bench.py \
+        --out benchmarks/results/reshard_churn_np8.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HOSTS = ["localhost"] + [f"127.0.0.{i}" for i in range(2, 9)]
+VICTIM_BATCH = 5  # SIGSTOP once the victim has committed this many
+
+_TRAIN = """
+import os
+import time
+
+import numpy as np
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0, params=np.zeros(4, np.float32))
+print("WORKER_PID r%d %d %s" % (
+    hvd.rank(), os.getpid(),
+    os.environ.get("HOROVOD_HOSTNAME", "?")), flush=True)
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 40:
+        grad = hvd.allreduce(
+            np.full(4, float(state.batch + 1), np.float32), name="g")
+        state.params = state.params + np.asarray(grad)
+        state.batch += 1
+        state.commit()
+        print("BATCH r%d %d t=%.6f" % (
+            hvd.rank(), state.batch, time.monotonic()), flush=True)
+        time.sleep(0.05)
+
+train(state)
+print("FINAL_PARAMS r%d %s" % (
+    hvd.rank(), np.asarray(state.params).tobytes().hex()), flush=True)
+hvd.shutdown()
+"""
+
+
+def _run_arm(workdir: str, reshard_enabled: bool, deadline_s: int,
+             lease_s: float, timeout_s: int) -> dict:
+    hosts_file = os.path.join(workdir, "hosts.txt")
+    with open(hosts_file, "w") as f:
+        f.write("".join(f"{h}:1\n" for h in HOSTS))
+    disc = os.path.join(workdir, "discover.sh")
+    with open(disc, "w") as f:
+        f.write(f"#!/bin/sh\ncat {hosts_file}\n")
+    os.chmod(disc, 0o755)
+    train = os.path.join(workdir, "train.py")
+    with open(train, "w") as f:
+        f.write(_TRAIN)
+
+    env = os.environ.copy()
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_TRANSPORT": "tcp",
+        "HOROVOD_TCP_PROGRESS_DEADLINE_SECS": str(deadline_s),
+        "HOROVOD_LEASE_TIMEOUT_SECS": str(lease_s),
+        "HOROVOD_RESHARD": "1" if reshard_enabled else "0",
+        "HOROVOD_LOG_LEVEL": "info",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", str(len(HOSTS)), "--min-np", "4",
+         "--host-discovery-script", disc,
+         sys.executable, train],
+        cwd=REPO_ROOT, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+    pids = {}        # rank -> (pid, hostname)
+    batches = {}     # rank -> [(batch, t)]
+    finals = {}      # rank -> params hex
+    stdout_lines = []
+    victim = {"stopped": False, "pid": None}
+    lock = threading.Lock()
+
+    def _on_line(line: str) -> None:
+        stdout_lines.append(line)
+        m = re.match(r"WORKER_PID r(\d+) (\d+) (\S+)", line)
+        if m:
+            with lock:
+                pids[int(m.group(1))] = (int(m.group(2)), m.group(3))
+            return
+        m = re.match(r"BATCH r(\d+) (\d+) t=([0-9.]+)", line)
+        if m:
+            rank, batch, t = int(m.group(1)), int(m.group(2)), \
+                float(m.group(3))
+            with lock:
+                batches.setdefault(rank, []).append((batch, t))
+            # Silent preemption: freeze the victim (rank 3) once it has
+            # committed VICTIM_BATCH batches, and take its host out of
+            # the discovery pool in the same breath.
+            if rank == 3 and batch >= VICTIM_BATCH \
+                    and not victim["stopped"] and 3 in pids:
+                victim["stopped"] = True
+                victim["pid"], victim_host = pids[3]
+                with open(hosts_file, "w") as f:
+                    f.write("".join(f"{h}:1\n" for h in HOSTS
+                                    if h != victim_host))
+                os.kill(victim["pid"], signal.SIGSTOP)
+            return
+        m = re.match(r"FINAL_PARAMS r(\d+) ([0-9a-f]+)", line)
+        if m:
+            with lock:
+                finals[int(m.group(1))] = m.group(2)
+
+    def _pump() -> None:
+        for line in proc.stdout:
+            _on_line(line.rstrip("\n"))
+
+    # stderr must drain concurrently too: the driver's info-level log is
+    # chatty enough to fill the pipe and deadlock the launcher.
+    stderr_lines = []
+
+    def _pump_err() -> None:
+        for line in proc.stderr:
+            stderr_lines.append(line)
+
+    pump = threading.Thread(target=_pump, daemon=True)
+    pump.start()
+    pump_err = threading.Thread(target=_pump_err, daemon=True)
+    pump_err.start()
+
+    # The frozen victim can never answer the driver's exit ping, so the
+    # launcher would wait on it forever; reap it once every survivor has
+    # printed final params (the measurement is already over by then).
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        with lock:
+            done = len(finals) >= len(HOSTS) - 1
+        if done or proc.poll() is not None:
+            break
+        time.sleep(0.25)
+    if victim["pid"] is not None:
+        try:
+            os.kill(victim["pid"], signal.SIGKILL)
+        except OSError:
+            pass
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30)
+    pump.join(timeout=10)
+    pump_err.join(timeout=10)
+    stderr = "".join(stderr_lines)
+
+    with lock:
+        # The shrink re-ranks the new world 0..6, so rank 3 DOES appear
+        # among the finals — it is a different (surviving) process; the
+        # frozen victim never prints one.
+        survivor_finals = dict(finals)
+        rank0 = sorted(batches.get(0, []), key=lambda bt: bt[0])
+    if len(survivor_finals) < len(HOSTS) - 1:
+        raise RuntimeError(
+            f"arm reshard={reshard_enabled}: only {len(survivor_finals)} "
+            f"survivors finished (ranks {sorted(survivor_finals)})\n"
+            f"{stderr[-3000:]}")
+    if len(set(survivor_finals.values())) != 1:
+        raise RuntimeError("survivors diverged")
+    gaps = [(b1, t1 - t0) for (b0, t0), (b1, t1)
+            in zip(rank0, rank0[1:])]
+    churn_batch, churn_gap = max(gaps, key=lambda g: g[1])
+    return {
+        "reshard_enabled": reshard_enabled,
+        "victim_stopped": victim["stopped"],
+        "churn_to_first_step_s": round(churn_gap, 3),
+        "resumed_at_batch": churn_batch,
+        "rank0_batches": len(rank0),
+        "survivors_final_param_hex": sorted(
+            set(survivor_finals.values()))[0],
+        "reshard_marker_published": "published with reshard marker"
+                                    in stderr,
+        "reshard_committed": "reshard committed at epoch" in stderr,
+        "launcher_returncode": proc.returncode,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python benchmarks/reshard_bench.py")
+    p.add_argument("--deadline", type=int, default=60,
+                   help="TCP progress deadline (s) for BOTH arms; "
+                        "production default is 600 — the committed 60 "
+                        "understates the legacy arm's stall")
+    p.add_argument("--lease", type=float, default=3.0)
+    p.add_argument("--timeout", type=int, default=420,
+                   help="per-arm wall clock bound (s)")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    arms = {}
+    for enabled in (True, False):
+        name = "reshard" if enabled else "legacy_teardown"
+        print(f"--- arm: {name} ---", flush=True)
+        with tempfile.TemporaryDirectory() as wd:
+            arms[name] = _run_arm(wd, enabled, args.deadline, args.lease,
+                                  args.timeout)
+        print(json.dumps(arms[name]), flush=True)
+
+    if not arms["reshard"]["reshard_committed"]:
+        raise RuntimeError("reshard arm never committed — the A/B "
+                           "compared nothing")
+    if arms["legacy_teardown"]["reshard_marker_published"]:
+        raise RuntimeError("legacy arm published a reshard marker — the "
+                           "kill-switch failed")
+    if arms["reshard"]["survivors_final_param_hex"] != \
+            arms["legacy_teardown"]["survivors_final_param_hex"]:
+        raise RuntimeError("arms converged to different params")
+    ratio = (arms["legacy_teardown"]["churn_to_first_step_s"]
+             / max(1e-9, arms["reshard"]["churn_to_first_step_s"]))
+    record = {
+        "benchmark": "reshard_churn_np8",
+        "np": len(HOSTS),
+        "churn_model": "silent preemption: SIGSTOP victim + host removed "
+                       "from discovery (sockets stay open; no EOF)",
+        "tcp_progress_deadline_s": args.deadline,
+        "production_default_deadline_s": 600,
+        "lease_timeout_s": args.lease,
+        "arms": arms,
+        "improvement_ratio": round(ratio, 2),
+    }
+    print(json.dumps(record, indent=2), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record) + "\n")
+    return 0 if ratio >= 10.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,9 +32,11 @@ cd "$ROOT"
 rm -rf hvd_flight_recorder/ hvd_flight_recorder.rank*.json
 
 # No `... | tee` here: plain sh has no pipefail, so a pipeline would
-# swallow pytest's exit status and always report PASSED.
+# swallow pytest's exit status and always report PASSED.  The slow-marked
+# np=8 reshard proofs are excluded here and run in their own lane below.
 rc=0
-JAX_PLATFORMS=cpu python -m pytest tests/test_fault_injection.py -m chaos \
+JAX_PLATFORMS=cpu python -m pytest tests/test_fault_injection.py \
+    -m "chaos and not slow" \
     -v -p no:cacheprovider "$@" > ci/chaos.last.log 2>&1 || rc=$?
 cat ci/chaos.last.log
 [ "$rc" -eq 0 ] || { echo "chaos lane FAILED (rc=$rc)"; exit "$rc"; }
@@ -82,4 +84,20 @@ python -m pytest "tests/test_sim_cluster.py::test_sim_demotion_np128_artifact" \
     -m slow -v -p no:cacheprovider > ci/chaos.demotion.log 2>&1 || rc=$?
 cat ci/chaos.demotion.log
 [ "$rc" -eq 0 ] || { echo "demotion lane FAILED (rc=$rc)"; exit "$rc"; }
+
+# Zero-restart reshard lane (docs/elastic.md "Live resharding"): the
+# np=8 live proof — a rank SIGKILL'd mid-train, the reshard-marked
+# publish, the survivor-acked commit, exactly one post-churn spawn (the
+# victim's identity back as a joiner), bit-identical convergence — plus
+# the HOROVOD_RESHARD=0 kill-switch variant converging through the
+# legacy fallback.  Both under HOROVOD_LOCK_DEBUG=1 (the jobs arm it in
+# their own env too; this instruments the test process as well).
+echo "reshard lane: np=8 live churn under HOROVOD_LOCK_DEBUG=1"
+rc=0
+JAX_PLATFORMS=cpu HOROVOD_LOCK_DEBUG=1 \
+python -m pytest tests/test_fault_injection.py -m "chaos and slow" \
+    -k "live_reshard" -v -p no:cacheprovider \
+    > ci/chaos.reshard.log 2>&1 || rc=$?
+cat ci/chaos.reshard.log
+[ "$rc" -eq 0 ] || { echo "reshard lane FAILED (rc=$rc)"; exit "$rc"; }
 echo "chaos lane PASSED"

@@ -64,6 +64,21 @@ rc=0
                "the bug class it exists for"
           false
       fi; } &&
+    # Second teeth guard, for the reshard invariants specifically: the
+    # unguarded-commit bug (survivor acks forged at the probe) MUST be
+    # found by the store-side early-commit check — exit 1, not 0.
+    { inject_rc=0; python -m horovod_tpu.tools.mck proto \
+          --inject reshard_commit_unguarded -q > /dev/null 2>&1 \
+          || inject_rc=$?
+      if [ "$inject_rc" -eq 1 ]; then
+          echo "hvd-mck proto: injected unguarded reshard commit is" \
+               "found (expected)"
+      else
+          echo "hvd-mck proto: injected reshard run exited $inject_rc," \
+               "expected 1 (violations found) — the reshard early-commit" \
+               "invariant has gone blind"
+          false
+      fi; } &&
     # And the full proto kill suite: every seeded protocol bug dead.
     python -m horovod_tpu.tools.mck proto --mutants -q &&
     JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py tests/test_mck.py \

@@ -13,6 +13,12 @@ set -eu
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$ROOT"
 
+# Sweep stale flight-recorder dumps BEFORE running, the way the chaos
+# and lint lanes already do: an earlier crashed run leaves
+# hvd_flight_recorder/ post-mortems in the cwd, and anything judging
+# dump presence downstream would read last week's wreckage.
+rm -rf hvd_flight_recorder/ hvd_flight_recorder.rank*.json
+
 rc=0
 {
     JAX_PLATFORMS=cpu python - <<'EOF' > ci/metrics_smoke.last.scrape &&

@@ -97,6 +97,14 @@ HOROVOD_SIM_SEED = "HOROVOD_SIM_SEED"
 # Monotonic membership epoch, stamped by the elastic driver into every
 # worker env and bumped on each re-rendezvous; read via ``get_epoch()``.
 HOROVOD_EPOCH = "HOROVOD_EPOCH"
+# Zero-restart resharding ("1"/"0", default on): on an epoch advance with
+# ≥1 surviving worker the driver stamps the published slot table with a
+# reshard marker; survivors abort in-flight collectives and re-rendezvous
+# IN PLACE (no process exit/respawn) and joiners receive state over the
+# collectives instead of a checkpoint read (docs/elastic.md "Live
+# resharding").  "0" is the kill-switch back to the legacy full-teardown
+# path; a survivor crash mid-reshard degrades to that path automatically.
+HOROVOD_RESHARD = "HOROVOD_RESHARD"
 HOROVOD_ELASTIC_RESET_LIMIT = "HOROVOD_ELASTIC_RESET_LIMIT"
 # Blacklist strike thresholds (elastic/constants.py holds the defaults):
 # crash exits use the low limit, TRANSIENT_EXIT_CODE exits the high one.

@@ -198,8 +198,21 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "driver_epoch_transitions_total": (
         "counter", "elastic driver epoch advances, labeled cause="
                    "lease_expiry|demotion|reset_request|worker_exit|"
-                   "host_change (driver only; the flight recorder "
-                   "carries the same cause tag per event)"),
+                   "host_change|reshard (driver only; the flight recorder "
+                   "carries the same cause tag per event; a zero-restart "
+                   "reshard counts BOTH its churn cause and one extra "
+                   "cause=reshard sample when the commit lands)"),
+    "reshard_seconds": (
+        "histogram", "zero-restart reshard duration, driver side: "
+                     "reshard-marked slot-table publish through the "
+                     "survivor-acked topology commit (driver only; no "
+                     "sample when the epoch falls back to the legacy "
+                     "full-teardown path)"),
+    "reshard_fallbacks_total": (
+        "counter", "reshard attempts abandoned to the legacy full-"
+                   "teardown path (a survivor crashed or stopped acking "
+                   "mid-reshard, so the next epoch published without the "
+                   "marker)"),
     # -- integrity / failure plane --
     "crc_verify_seconds_total": (
         "counter", "seconds spent computing/verifying wire CRC32 "

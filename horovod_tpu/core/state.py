@@ -1062,6 +1062,32 @@ def global_state() -> HorovodGlobalState:
     return _global_state
 
 
+def abort_for_reshard(epoch: Optional[int] = None) -> None:
+    """Prompt-abort hook for a reshard-marked notify ping (elastic
+    worker service → here): flip this rank's mesh abort flag and relay
+    the abort, so a survivor blocked in a collective on a dead peer
+    raises ``CoordinatedAbortError`` within one poll quantum instead of
+    riding out the TCP progress deadline — the dominant term in legacy
+    churn-to-first-step latency.  Best-effort by contract (the retry
+    wrapper's normal reset path is the backstop) and epoch-filtered:
+    a ping carrying an epoch ≤ the one we already run at is stale
+    (the same consume-time staleness rule ``notify_hosts_updated``
+    applies) and must not poison the CURRENT world's collectives."""
+    from ..common import env as env_mod
+
+    if epoch is not None and epoch <= env_mod.get_epoch():
+        return
+    st = _global_state
+    if st.mesh is None or not st.initialized.is_set():
+        return
+    try:
+        st.mesh.send_abort(
+            f"elastic reshard to epoch {epoch}: re-rendezvous in place")
+    except Exception as e:  # noqa: BLE001 — best-effort fast path; the
+        # progress deadline still unblocks the slow way
+        log.debug("reshard abort broadcast failed: %s", e)
+
+
 def reset_global_state() -> HorovodGlobalState:
     global _global_state
     _global_state.reset()

@@ -293,6 +293,80 @@ def tick_judgment_steps(epoch: int, fetched: Dict[str, object],
     return j
 
 
+def reshard_plan(table: Dict[str, dict], known_identities,
+                 enabled: bool, pending: Optional[dict],
+                 recent_joiners=()) -> Dict[str, object]:
+    """Pure reshard judgment for one epoch publish (model-checked; the
+    production ``_rendezvous_epoch`` and the ``hvd-mck proto`` model
+    driver both call THIS).
+
+    ``table`` is the slot table about to be published; ``known_identities``
+    is the set of identities with a live worker process from the previous
+    epoch (the spawn loop's exact complement: everything ranked but not
+    known gets spawned).  ``survivors`` are the process-keeping ranked
+    identities — the set whose epoch acks gate the commit.  ``joiners``
+    (the sync targets) are the about-to-be-spawned identities PLUS any
+    survivor that was itself a joiner of the immediately previous epoch
+    (``recent_joiners``): its ack proves adoption, not a completed state
+    sync, so until an epoch with it as a plain survivor commits it may
+    still hold blank init state.  ``sync_root`` is therefore the lowest
+    rank among SEASONED survivors only — rank 0 itself may be the fresh
+    process being state-filled, and a recent joiner as root could
+    broadcast blank state over everyone's progress.  No seasoned
+    survivor ⇒ not eligible (legacy full sync from rank 0).
+
+    The fallback rule is load-bearing: while a previous reshard is
+    ``pending`` (published but never survivor-acked to commit — a
+    survivor crashed mid-reshard), the NEXT publish must NOT carry the
+    marker, degrading those workers to the legacy full-teardown path
+    (mck: V_RESHARD_FALLBACK_MISSED / ``reshard_fallback_dropped``)."""
+    keepers = sorted(i for i, s in table.items()
+                     if s["rank"] >= 0 and i in known_identities)
+    spawning = sorted(i for i, s in table.items()
+                      if s["rank"] >= 0 and i not in known_identities)
+    recent = set(recent_joiners)
+    seasoned = [i for i in keepers if i not in recent]
+    joiners = sorted(set(spawning) | (set(keepers) & recent))
+    fallback = pending is not None
+    eligible = enabled and bool(seasoned) and not fallback
+    sync_root = min((table[i]["rank"] for i in seasoned), default=0)
+    return {"eligible": eligible, "fallback": fallback,
+            "survivors": keepers, "joiners": joiners,
+            "sync_root": sync_root}
+
+
+def reshard_commit_steps(epoch: int, survivors):
+    """One commit-probe of a pending zero-restart reshard.
+
+    The ordering the checker proves lives HERE: the durable commit record
+    is written ONLY after every listed survivor's epoch ack for ``epoch``
+    is readable in the store — writing it earlier is exactly the seeded
+    ``reshard_commit_unguarded`` mutant (V_RESHARD_EARLY_COMMIT): a
+    crash after an early commit would adopt a topology some survivor
+    never agreed to rejoin.  Returns ``{"committed", "missing"}``; the
+    caller re-probes next tick while survivors are still rendezvousing,
+    and an epoch ADVANCE while still missing is the fallback path."""
+    if not survivors:
+        return {"committed": False, "missing": []}
+    acks = yield (STEP_TXN,
+                  tuple(("get", EPOCH_ACK_SCOPE, i) for i in survivors),
+                  "reshard_acks")
+    missing = []
+    for identity, raw in zip(survivors, acks):
+        try:
+            acked = int(bytes(raw).decode()) if raw is not None else -1
+        except ValueError:
+            acked = -1
+        if acked < epoch:
+            missing.append(identity)
+    if missing:
+        return {"committed": False, "missing": missing}
+    yield (STEP_TXN,
+           (("set", DRIVER_SCOPE, "reshard_commit", str(epoch).encode()),),
+           "reshard_commit")
+    return {"committed": True, "missing": []}
+
+
 def outage_recovery_steps(lease_timeout: float):
     """Steps on the first successful fetch after a store outage: workers
     could not renew through it (their pushes go to the same store), so
@@ -414,6 +488,27 @@ class ElasticDriver:
         # every worker gets one full timeout to show life first.
         self._lease_grace_until = 0.0
         self._store_outage_since: Optional[float] = None
+        # -- zero-restart resharding (docs/elastic.md "Live resharding") --
+        self.reshard_enabled = env_mod.get_bool(env_mod.HOROVOD_RESHARD,
+                                                True)
+        # The published-but-uncommitted reshard, or None: {"epoch",
+        # "survivors", "published_ns", "missing"}.  Commit lands when
+        # every listed survivor has acked the epoch (reshard_commit_steps,
+        # probed each tick); an epoch advance while still pending is the
+        # legacy-fallback path and publishes WITHOUT the marker.
+        self._reshard_pending: Optional[dict] = None
+        # Joiners of the most recent MARKED publish: their acks prove
+        # epoch adoption, not a completed state sync, so the next plan
+        # re-lists them as joiners and never picks them as sync root
+        # (see reshard_plan).  Cleared by any unmarked publish — a legacy
+        # epoch full-syncs everyone from rank 0.
+        self._last_reshard_joiners: set = set()
+        # Epoch adopted by recover_from_store (None = fresh start): the
+        # value the initial republish CAS-fences on, so a crashed
+        # incarnation's in-flight publish landing after our recovery
+        # read fails the republish instead of being stomped with a
+        # stale epoch (mck: reshard_driver_crash / epoch-regression).
+        self._recovered_epoch: Optional[int] = None
 
     # ------------------------------------------------------------------
 
@@ -436,7 +531,22 @@ class ElasticDriver:
         """Publish epoch 0 assignments, spawn workers, start discovery."""
         self._create_worker = create_worker
         self.wait_for_available_slots()
-        self._rendezvous_epoch(initial=True)
+        for attempt in range(5):
+            if self._rendezvous_epoch(initial=True):
+                break
+            # The initial publish lost its epoch fence: a previous
+            # incarnation's in-flight publish landed after our recovery
+            # read.  Re-adopt from the store and republish at the newer
+            # epoch instead of stomping it with the stale one.
+            log.warning("initial epoch publish lost its fence (attempt "
+                        "%d); re-adopting driver state from the store",
+                        attempt + 1)
+            self.recover_from_store()
+        else:
+            raise RuntimeError(
+                "could not fence the initial epoch publish after 5 "
+                "recovery attempts: the store's epoch keeps moving "
+                "under us")
         self._discovery_thread = threading.Thread(
             target=self._discovery_loop, name="hvd-elastic-discovery",
             daemon=True)
@@ -459,7 +569,7 @@ class ElasticDriver:
         np_ = min(total, self.max_np) if self.max_np else total
         return get_host_assignments(hosts, min(self.min_np, np_), np_)
 
-    def _rendezvous_epoch(self, initial: bool = False) -> None:
+    def _rendezvous_epoch(self, initial: bool = False) -> bool:
         with self._lock:
             if not initial:
                 self.epoch += 1
@@ -486,6 +596,41 @@ class ElasticDriver:
                         "cross_rank": -1, "size": 0, "local_size": 0,
                         "cross_size": 0, "epoch": self.epoch,
                     }
+            # Zero-restart reshard judgment (pure kernel, shared with the
+            # mck model driver): survivors/joiners/sync_root from the
+            # table about to go out.  Eligible ⇒ every entry carries the
+            # marker in the SAME atomic publish; a still-pending previous
+            # reshard forces the fallback (no marker — survivors of the
+            # failed reshard take the legacy full-teardown path).
+            plan = reshard_plan(
+                table, set(self._known_identities),
+                enabled=self.reshard_enabled and not initial,
+                pending=self._reshard_pending,
+                recent_joiners=self._last_reshard_joiners)
+            if plan["fallback"]:
+                failed = self._reshard_pending
+                self._reshard_pending = None
+                metrics.inc("reshard_fallbacks_total")
+                flight_recorder.record(
+                    "reshard_fallback", epoch=self.epoch,
+                    pending_epoch=failed["epoch"],
+                    missing=sorted(failed.get("missing") or []))
+                log.warning(
+                    "reshard for epoch %d never committed (unacked: %s); "
+                    "epoch %d falls back to the full-teardown path",
+                    failed["epoch"], sorted(failed.get("missing") or []),
+                    self.epoch)
+            if plan["eligible"]:
+                # "survivors" rides the published entries so the store
+                # holds ground truth for the commit's ack set (the mck
+                # store-side V_RESHARD_EARLY_COMMIT check reads it; it
+                # also makes a wedged reshard diagnosable from the store
+                # alone).
+                for slot in table.values():
+                    slot["reshard"] = True
+                    slot["sync_root"] = plan["sync_root"]
+                    slot["joiners"] = plan["joiners"]
+                    slot["survivors"] = plan["survivors"]
             # One batched transaction: the whole slot table plus the
             # durable epoch land atomically (a driver crash mid-publish
             # can no longer leave a half-written table for
@@ -498,7 +643,44 @@ class ElasticDriver:
                 for identity, slot in table.items()]
             publish_ops.append(("set", DRIVER_SCOPE, "epoch",
                                 str(self.epoch).encode()))
-            self.rendezvous.batch(publish_ops)
+            if initial:
+                # Fence the initial/recovery republish on the epoch we
+                # adopted (absent on a fresh start): a crashed
+                # incarnation's in-flight publish landing after our
+                # recovery read must fail this batch, not get stomped
+                # with a stale epoch.  start() re-adopts and retries.
+                expected = None if self._recovered_epoch is None \
+                    else str(self._recovered_epoch).encode()
+                publish_ops.insert(
+                    0, ("check", DRIVER_SCOPE, "epoch", expected))
+            if plan["eligible"]:
+                # Armed BEFORE the publish on purpose: a store error on
+                # the batch does not prove the marked table never landed
+                # (the lost half may be the ack), and an armed pending
+                # is safe either way — if the marker never landed, no
+                # survivor can ack this epoch, the commit never fires,
+                # and the next advance falls back to the legacy path.
+                self._reshard_pending = {
+                    "epoch": self.epoch,
+                    "survivors": plan["survivors"],
+                    "published_ns": time.monotonic_ns(),
+                    "missing": list(plan["survivors"]),
+                }
+                self._last_reshard_joiners = set(plan["joiners"])
+            else:
+                self._last_reshard_joiners = set()
+            results = self.rendezvous.batch(publish_ops)
+            if initial and results and results[0] is False:
+                return False  # fence lost; start() re-adopts + retries
+            if plan["eligible"]:
+                flight_recorder.record(
+                    "reshard_publish", epoch=self.epoch,
+                    survivors=plan["survivors"], joiners=plan["joiners"],
+                    sync_root=plan["sync_root"])
+                log.info("epoch %d published with reshard marker "
+                         "(%d survivors, %d joiners, sync_root=%d)",
+                         self.epoch, len(plan["survivors"]),
+                         len(plan["joiners"]), plan["sync_root"])
 
             # Spawn processes for identities that have none yet.  A
             # driver-spawned worker is born at this epoch, so it is
@@ -532,9 +714,11 @@ class ElasticDriver:
                 i for i in self._known_identities if i not in current}
             for identity in self._removed_identities:
                 self._known_identities.pop(identity)
+            return True
 
     def _notify_workers(self, added_only: bool,
-                        identities: Optional[set] = None) -> None:
+                        identities: Optional[set] = None,
+                        reshard: bool = False) -> None:
         if identities is None:
             # Removed identities are notified too: their table entry says
             # rank −1, and the ping is what makes them exit promptly
@@ -555,7 +739,7 @@ class ElasticDriver:
                  "(unregistered: %s)", len(addresses), self.epoch,
                  missing or "none")
         WorkerNotificationClient(addresses).notify_hosts_updated(
-            added_only, epoch=self.epoch)
+            added_only, epoch=self.epoch, reshard=reshard)
 
     def _discovery_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -595,6 +779,7 @@ class ElasticDriver:
             self._renotify_unacked(fetched.get("epoch_ack"))
             self._store_recovered()
             self._push_driver_metrics()
+            self._reshard_commit_probe()
         except self._STORE_ERRORS as e:
             self._store_outage(e)
             return
@@ -658,7 +843,8 @@ class ElasticDriver:
                  j["demoted"], cause)
         self._rendezvous_epoch()
         self._await_ack = not removalish  # remember flavor for re-notify
-        self._notify_workers(added_only=not removalish)
+        self._notify_workers(added_only=not removalish,
+                             reshard=self._reshard_pending is not None)
         metrics.inc("driver_epoch_transitions_total", cause=cause)
         flight_recorder.record(
             "epoch_transition", epoch=self.epoch, cause=cause,
@@ -730,6 +916,33 @@ class ElasticDriver:
                 ewma=rep.get("ewma"), new_strike=new_strike,
                 reporter=rep.get("reporter"))
             log.warning("demoting host %s: %s", host, evidence)
+
+    def _reshard_commit_probe(self) -> None:
+        """Drive one commit-probe of the pending reshard (kernel:
+        :func:`reshard_commit_steps`) against the live store.  Commit ⇒
+        observe ``reshard_seconds`` (marker publish → survivor-acked
+        commit), count the extra ``cause=reshard`` transition sample, and
+        flight-record it; still-missing acks just carry to the next tick
+        (an epoch advance meanwhile is the fallback path).  Store errors
+        propagate to the tick's partitioned-mode handler."""
+        pending = self._reshard_pending
+        if pending is None:
+            return
+        res = self._drive_txn_steps(reshard_commit_steps(
+            pending["epoch"], pending["survivors"]))
+        pending["missing"] = res["missing"]
+        if not res["committed"]:
+            return
+        self._reshard_pending = None
+        elapsed = (time.monotonic_ns() - pending["published_ns"]) / 1e9
+        metrics.observe("reshard_seconds", elapsed)
+        metrics.inc("driver_epoch_transitions_total", cause="reshard")
+        flight_recorder.record(
+            "reshard_commit", epoch=pending["epoch"],
+            survivors=pending["survivors"], seconds=round(elapsed, 6))
+        log.info("reshard committed at epoch %d (%d survivors, %.3fs "
+                 "publish-to-commit)", pending["epoch"],
+                 len(pending["survivors"]), elapsed)
 
     def _tick_store_reads(self) -> Dict[str, Optional[Dict[str, object]]]:
         """Coalesce this tick's store reads into ONE batched round-trip.
@@ -855,10 +1068,13 @@ class ElasticDriver:
         except (self._STORE_ERRORS, ValueError) as e:
             log.warning("driver state recovery failed (%s); starting "
                         "fresh at epoch 0", e)
+            self._recovered_epoch = None
             return False
         if recovered is None:
+            self._recovered_epoch = None
             return False
         self.epoch = recovered["epoch"]
+        self._recovered_epoch = recovered["epoch"]
         now = time.monotonic()
         for identity, (slot, lease) in recovered["adopted"].items():
             info = SlotInfo(
@@ -906,7 +1122,8 @@ class ElasticDriver:
         if not unacked:
             self._await_ack = None
             return
-        self._notify_workers(added_only=self._await_ack, identities=unacked)
+        self._notify_workers(added_only=self._await_ack, identities=unacked,
+                             reshard=self._reshard_pending is not None)
 
     def record_worker_exit(self, slot: SlotInfo, exit_code: int) -> None:
         """Called by the launcher's process monitor (reference

@@ -181,6 +181,40 @@ def post_demotion_report(rank: int, ewma: float, threshold: float,
         return False
 
 
+def current_reshard_info() -> Optional[dict]:
+    """Reshard marker on THIS identity's slot entry at the CURRENT epoch,
+    or None (no marker / stale entry / no store / store error — every
+    miss degrades to the legacy full-sync path, never the reverse).
+
+    Read fresh from the store rather than cached from
+    ``refresh_topology_from_rendezvous`` on purpose: spawned joiners
+    never pass through refresh (they are born at the new epoch, env
+    pre-set by the driver), yet must agree with the survivors on
+    ``sync_root`` for the state broadcast to be one collective.  The
+    epoch check kills both race directions — a fallback republish at
+    E+1 while we sync for E, and a late reshard publish while we still
+    run E−1."""
+    store = store_client()
+    if store is None:
+        return None
+    try:
+        raw = store.get(RANK_AND_SIZE_SCOPE, _identity())
+    except Exception:  # noqa: BLE001 — advisory fast path only
+        return None
+    if raw is None:
+        return None
+    try:
+        slot = json.loads(bytes(raw).decode())
+    except ValueError:
+        return None
+    if not isinstance(slot, dict) or not slot.get("reshard") \
+            or slot.get("epoch", -1) != env_mod.get_epoch():
+        return None
+    return {"epoch": slot["epoch"],
+            "sync_root": int(slot.get("sync_root", 0)),
+            "joiners": list(slot.get("joiners") or [])}
+
+
 def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
     """Blocks until the driver publishes a slot table for a NEW epoch, then
     adopts this process's new coordinates (exits if removed)."""
@@ -238,7 +272,8 @@ def refresh_topology_from_rendezvous(timeout: float = 120.0) -> ProcessTopology:
     metrics.inc("elastic_epoch_changes_total")
     metrics.set_gauge("elastic_epoch", slot["epoch"])
     flight_recorder.record("epoch_change", epoch=slot["epoch"],
-                           rank=slot["rank"], size=slot["size"])
+                           rank=slot["rank"], size=slot["size"],
+                           reshard=bool(slot.get("reshard")))
     return ProcessTopology(
         rank=slot["rank"], size=slot["size"],
         local_rank=slot["local_rank"], local_size=slot["local_size"],

@@ -94,7 +94,7 @@ class State:
     def restore(self) -> None:
         raise NotImplementedError
 
-    def sync(self) -> None:
+    def sync(self, root_rank: int = 0) -> None:
         raise NotImplementedError
 
     def reset(self) -> None:
@@ -120,11 +120,18 @@ class ObjectState(State):
         for k, v in self._saved.items():
             setattr(self, k, copy.deepcopy(v))
 
-    def sync(self) -> None:
+    def sync(self, root_rank: int = 0) -> None:
         from ..frameworks.jax.functions import broadcast_object
 
         values = {k: getattr(self, k) for k in self._known}
-        synced = broadcast_object(values, root_rank=0, name="elastic.objstate")
+        synced = broadcast_object(values, root_rank=root_rank,
+                                  name="elastic.objstate")
+        # Adopt the ROOT's attribute set, not just its values: a joiner
+        # whose constructor defaults differ from the coordinator's
+        # evolved set (attributes added/dropped across restarts) must
+        # track exactly what the root tracks, or its next save/restore
+        # cycle snapshots keys nobody else agrees on.
+        self._known = list(synced.keys())
         for k, v in synced.items():
             setattr(self, k, v)
         self.save()
@@ -157,7 +164,7 @@ class JaxState(ObjectState):
         for k, v in self._saved.items():
             setattr(self, k, copy.deepcopy(v))
 
-    def sync(self) -> None:
+    def sync(self, root_rank: int = 0) -> None:
         import jax
 
         from ..frameworks.jax.functions import broadcast_parameters
@@ -166,12 +173,13 @@ class JaxState(ObjectState):
             tree = getattr(self, k)
             leaves = jax.tree_util.tree_leaves(tree)
             if leaves and all(hasattr(l, "shape") for l in leaves):
-                setattr(self, k, broadcast_parameters(tree, root_rank=0))
+                setattr(self, k, broadcast_parameters(
+                    tree, root_rank=root_rank))
             else:
                 from ..frameworks.jax.functions import broadcast_object
 
                 setattr(self, k, broadcast_object(
-                    tree, root_rank=0, name=f"elastic.sync.{k}"))
+                    tree, root_rank=root_rank, name=f"elastic.sync.{k}"))
         self.save()
 
 
@@ -321,6 +329,43 @@ def _request_epoch_reset(err: BaseException) -> None:
     request_reset(f"{type(err).__name__}: {err}")
 
 
+def _sync_for_epoch(state: State) -> None:
+    """Post-reinit state sync, reshard-aware (docs/elastic.md "Live
+    resharding").
+
+    Legacy path: broadcast everything from rank 0.  Under a
+    reshard-marked epoch: a pure shrink (no joiners) skips the sync
+    entirely — every participant is a survivor restored to the same
+    commit, so the broadcast would move zero information; with joiners,
+    broadcast from ``sync_root`` (the lowest SURVIVING rank — rank 0
+    itself may be the fresh process being state-filled, which on the
+    legacy root-0 rule would broadcast its blank init state over the
+    survivors' progress).  The marker is read from the store per
+    identity+epoch, so spawned joiners and re-rendezvoused survivors
+    agree on the same root without a side channel; any read miss
+    degrades to the legacy full sync, never the reverse."""
+    from ..common import env as env_mod
+
+    info = None
+    if env_mod.get_bool(env_mod.HOROVOD_ELASTIC) and \
+            env_mod.get_bool(env_mod.HOROVOD_RESHARD, True):
+        from .rendezvous_client import current_reshard_info
+
+        info = current_reshard_info()
+    if info is None:
+        state.sync()
+        return
+    from ..core import flight_recorder
+
+    if not info["joiners"]:
+        flight_recorder.record("reshard_sync_skipped", epoch=info["epoch"])
+        return
+    flight_recorder.record("reshard_sync", epoch=info["epoch"],
+                           root=info["sync_root"],
+                           joiners=len(info["joiners"]))
+    state.sync(root_rank=info["sync_root"])
+
+
 def _teardown() -> None:
     """Best-effort runtime teardown; never raises (used between retries)."""
     try:
@@ -382,7 +427,7 @@ def run(func: Callable) -> Callable:
                 pending_reset = False
             try:
                 if not skip_sync:
-                    state.sync()
+                    _sync_for_epoch(state)
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
                 state.restore()

@@ -43,9 +43,20 @@ class _NotifyHandler(BaseHTTPRequestHandler):
 
         parsed = urlparse(self.path)
         added_only = parsed.path.rstrip("/").endswith("added")
-        epoch_vals = parse_qs(parsed.query).get("epoch")
+        query = parse_qs(parsed.query)
+        epoch_vals = query.get("epoch")
         epoch = int(epoch_vals[0]) if epoch_vals else None
+        reshard_vals = query.get("reshard")
         notify_hosts_updated(added_only=added_only, epoch=epoch)
+        if reshard_vals and reshard_vals[0] == "1":
+            # Zero-restart reshard ping: abort in-flight collectives NOW
+            # so a survivor blocked on a SIGKILL'd peer re-rendezvouses
+            # within one poll quantum instead of riding out the TCP
+            # progress deadline.  Epoch-filtered inside (stale pings are
+            # the round-1 livelock); best-effort by contract.
+            from ..core.state import abort_for_reshard
+
+            abort_for_reshard(epoch)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -92,9 +103,12 @@ class WorkerNotificationClient:
         self._addresses = addresses
 
     def notify_hosts_updated(self, added_only: bool,
-                             epoch: Optional[int] = None) -> None:
+                             epoch: Optional[int] = None,
+                             reshard: bool = False) -> None:
         suffix = "added" if added_only else "changed"
         query = f"?epoch={epoch}" if epoch is not None else ""
+        if reshard:
+            query += ("&" if query else "?") + "reshard=1"
         from ..common import secret as secret_mod
 
         secret = secret_mod.job_secret()

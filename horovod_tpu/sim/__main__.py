@@ -16,6 +16,16 @@ latency curve (docs/elastic.md "self-healing demotion")::
 
     python -m horovod_tpu.sim --np 128 --demotions 3 \\
         --out benchmarks/results/sim_demotion_np128.json
+
+``--reshards N`` switches to the zero-restart reshard lane: N
+preemption kills drive marked epoch publishes, survivor acks, and
+commit records through the real driver, and the record is the
+kill→epoch→commit→first-round latency curve (docs/elastic.md "Live
+resharding").  Run it once more under ``HOROVOD_RESHARD=0`` for the
+legacy full-teardown baseline arm::
+
+    python -m horovod_tpu.sim --np 512 --reshards 4 \\
+        --out benchmarks/results/sim_reshard_np512.json
 """
 
 from __future__ import annotations
@@ -38,6 +48,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--demotions", type=int, default=0,
                    help="run the demotion lane instead: this many "
                         "chronic-straggler demotions per run")
+    p.add_argument("--reshards", type=int, default=0,
+                   help="run the reshard lane instead: this many "
+                        "preemption kills per run, each resolved by a "
+                        "live reshard (or the legacy path under "
+                        "HOROVOD_RESHARD=0)")
     p.add_argument("--seed", type=int, default=None,
                    help="override HOROVOD_SIM_SEED")
     p.add_argument("--lease-timeout", type=float, default=1.5)
@@ -53,8 +68,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             np_, slots_per_host=args.slots_per_host, seed=args.seed,
             lease_timeout=args.lease_timeout,
             renew_period=args.renew_period, trace=not args.no_trace)
-        rec = cluster.run_demotion(args.demotions) if args.demotions \
-            else cluster.run(args.events)
+        if args.reshards:
+            rec = cluster.run_reshard(args.reshards)
+        elif args.demotions:
+            rec = cluster.run_demotion(args.demotions)
+        else:
+            rec = cluster.run(args.events)
         line = json.dumps(rec)
         print(line, flush=True)
         lines.append(line)

@@ -590,3 +590,171 @@ class SimCluster:
         if attribution is not None:
             rec["attribution"] = attribution
         return rec
+
+    # -- zero-restart resharding (docs/elastic.md "Live resharding") ---
+    #
+    # Same separate-runner rationale as demotion: a new EVENT_KINDS
+    # member would reshuffle every committed churn schedule (and so
+    # every committed determinism digest) for the same seed.
+
+    def reshard_schedule(self, kills: int) -> List[str]:
+        """Deterministic preemption plan: ``kills`` victims sampled over
+        the static slot layout (repeats allowed — real preemption churn
+        revisits hosts).  Pure function of (seed, topology)."""
+        rng = random.Random(f"{self.seed}:reshard")
+        return [rng.choice(self.identities) for _ in range(kills)]
+
+    def await_reshard_commit(self, timeout: float) -> None:
+        """Drive renewal rounds until the driver's pending reshard
+        commits (every survivor's epoch ack on record).  Returns
+        immediately when nothing is pending — the HOROVOD_RESHARD=0
+        baseline arm never arms one."""
+        deadline = time.monotonic() + timeout
+        while self.driver._reshard_pending is not None:
+            if self.driver.finished():
+                raise RuntimeError(
+                    f"driver stopped awaiting reshard commit: "
+                    f"{self.driver.stopped_error}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"reshard at epoch {self.driver.epoch} not committed "
+                    f"in {timeout:.0f}s (unacked: "
+                    f"{self.driver._reshard_pending.get('missing')})")
+            self.renewal_round()
+            time.sleep(self.renew_period)
+
+    def reshard_digest(self, kills: int) -> str:
+        """Reshard-lane analog of :meth:`determinism_digest`: SHA-256
+        over the kill plan, slot layout, and wire previews — the
+        reproducibility witness for the committed artifact."""
+        links = {link: self._probe_wire(link).preview(4096, 4)
+                 for link in ["driver"] + self.hostnames}
+        blob = json.dumps({
+            "seed": self.seed, "np": self.np,
+            "slots_per_host": self.slots_per_host,
+            "identities": self.identities,
+            "reshard_schedule": self.reshard_schedule(kills),
+            "wire_previews": links,
+        }, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def run_reshard(self, kills: int, keep_dirs: bool = False) -> dict:
+        """Drive ``kills`` preemptions through the real driver with
+        live resharding and return the reshard-latency artifact.
+
+        Per kill: the victim goes silent, the REAL lease judgment
+        expires it, the epoch advance publishes the reshard-marked
+        table (survivors stay in place; the victim's slot respawns as a
+        joiner), survivors ack, and the driver's commit probe writes
+        the commit record.  Measured: kill→epoch (marked publish
+        visible), kill→commit (all survivor acks on record), and
+        kill→first-round (through the first completed control round of
+        the new world — the control-plane floor under the first
+        post-churn training step).  Under ``HOROVOD_RESHARD=0`` the
+        same runner measures the legacy full-teardown control path —
+        the baseline arm of the committed A/B artifact."""
+        plan = self.reshard_schedule(kills)
+        base_reshards = metrics.registry.get_counter(
+            "driver_epoch_transitions_total", cause="reshard")
+        base_fallbacks = metrics.registry.get_counter(
+            "reshard_fallbacks_total")
+        t0 = time.perf_counter()
+        self.start()
+        reshard_on = self.driver.reshard_enabled
+        bringup_ms = (time.perf_counter() - t0) * 1e3
+        event_records: List[dict] = []
+        try:
+            for _ in range(2):
+                self.renewal_round()
+                time.sleep(self.renew_period)
+            for victim in plan:
+                target = self.driver.epoch + 1
+                t_kill = time.perf_counter()
+                self.workers[victim].renewing = False
+                if metrics.ENABLED:
+                    metrics.inc("sim_churn_events_total", kind="reshard")
+                self.driver._wakeup.set()
+                self.await_epoch(
+                    target, timeout=30.0 + 3 * self.lease_timeout)
+                t_epoch = time.perf_counter()
+                pend = self.driver._reshard_pending
+                marked = pend is not None and pend["epoch"] >= target
+                self.ack_round(self.driver.epoch)
+                self.await_reshard_commit(
+                    timeout=30.0 + 3 * self.lease_timeout)
+                t_commit = time.perf_counter()
+                self.renewal_round()
+                t_round = time.perf_counter()
+                event_records.append({
+                    "victim": victim,
+                    "epoch": self.driver.epoch,
+                    "marked": marked,
+                    "kill_to_epoch_ms": round(
+                        (t_epoch - t_kill) * 1e3, 3),
+                    "kill_to_commit_ms": round(
+                        (t_commit - t_kill) * 1e3, 3),
+                    "kill_to_first_round_ms": round(
+                        (t_round - t_kill) * 1e3, 3),
+                })
+                if metrics.ENABLED:
+                    metrics.set_gauge("sim_identities", len(self._live()))
+                time.sleep(self.renew_period)
+        finally:
+            self.stop(keep_dirs=True)  # dirs still needed below
+
+        attribution = None
+        if self.trace:
+            from ..tools.control_path import analyze
+            from ..tools.trace_merge import load_trace, merge
+
+            doc = analyze(merge([
+                load_trace(os.path.join(self._tdir, "server.json")),
+                load_trace(os.path.join(self._tdir, "driver.json"))]))
+            attribution = {
+                "coverage": doc["coverage"],
+                "phase_share": doc["phase_share"],
+                "event_wall_ms_p50": round(doc["wall_us"]["p50"] / 1e3, 3),
+            }
+        journal_bytes = sum(
+            os.path.getsize(os.path.join(self._jdir, f))
+            for f in os.listdir(self._jdir))
+        if not keep_dirs:
+            for d in (self._jdir, self._tdir):
+                if d:
+                    shutil.rmtree(d, ignore_errors=True)
+
+        commit_lat = sorted(e["kill_to_commit_ms"] for e in event_records)
+        round_lat = sorted(e["kill_to_first_round_ms"]
+                           for e in event_records)
+        rec = {
+            "metric": "sim_reshard",
+            "np": self.np,
+            "hosts": len(self.hostnames),
+            "slots_per_host": self.slots_per_host,
+            "seed": self.seed,
+            "reshard_enabled": reshard_on,
+            "lease_timeout_s": self.lease_timeout,
+            "renew_period_s": self.renew_period,
+            "final_epoch": self.driver.epoch,
+            "bringup_ms": round(bringup_ms, 3),
+            "events": event_records,
+            "kill_to_commit_ms_p50": commit_lat[len(commit_lat) // 2],
+            "kill_to_commit_ms_max": commit_lat[-1],
+            "kill_to_first_round_ms_p50": round_lat[len(round_lat) // 2],
+            "kill_to_first_round_ms_max": round_lat[-1],
+            "driver_reshard_transitions": metrics.registry.get_counter(
+                "driver_epoch_transitions_total",
+                cause="reshard") - base_reshards,
+            "reshard_fallbacks": metrics.registry.get_counter(
+                "reshard_fallbacks_total") - base_fallbacks,
+            "sim_wire_delay_s": round(
+                sum(w.injected_s for w in self._wires.values()), 4),
+            "journal_bytes": journal_bytes,
+            "determinism": {
+                "digest": self.reshard_digest(kills),
+                "schedule": list(plan),
+            },
+        }
+        if attribution is not None:
+            rec["attribution"] = attribution
+        return rec

@@ -88,7 +88,8 @@ def _op_bytes(op: tuple) -> int:
     n = OP_OVERHEAD_BYTES + len(op[1])
     if len(op) > 2:
         n += len(op[2])
-    if len(op) > 3:
+    if len(op) > 3 and op[3] is not None:
+        # A CAS "check" against absence carries no value bytes.
         n += len(op[3])
     return n
 

@@ -56,7 +56,13 @@ Invariants (violation vocabulary below):
 - a live-leased identity is never dropped inside the post-outage
   re-grace window;
 - a restarted driver adopts exactly the epoch the journal-backed store
-  served it — never 0, never a stale predecessor.
+  served it — never 0, never a stale predecessor;
+- a zero-restart reshard commit record never lands before every
+  survivor the marked publish listed has acked that epoch, judged on
+  the store's own data (V_RESHARD_EARLY_COMMIT);
+- a reshard-marked slot table never publishes while an older marked
+  epoch sits uncommitted — the degradation to the legacy full-teardown
+  path is mandatory, not best-effort (V_RESHARD_FALLBACK_MISSED).
 """
 
 from __future__ import annotations
@@ -77,11 +83,14 @@ from ...elastic.driver import (
     STEP_TXN,
     outage_recovery_steps,
     recover_steps,
+    reshard_commit_steps,
+    reshard_plan,
     tick_judgment_steps,
     tick_read_steps,
 )
 from ...elastic.rendezvous_client import (
     DEMOTION_REPORT_SCOPE,
+    EPOCH_ACK_SCOPE,
     RANK_AND_SIZE_SCOPE,
     RESET_REQUEST_SCOPE,
     demotion_report_payload,
@@ -116,6 +125,7 @@ __all__ = [
     "V_EPOCH_REGRESSION", "V_MULTI_ADVANCE", "V_ACKED_LOST",
     "V_TORN_GROUP", "V_STALE_ACTED", "V_SMALL_WORLD_DEMOTION",
     "V_LIVE_DROPPED", "V_DEMOTED_HOST_KEPT", "V_RECOVER_MISMATCH",
+    "V_RESHARD_EARLY_COMMIT", "V_RESHARD_FALLBACK_MISSED",
     "V_MODEL_ERROR",
 ]
 
@@ -130,6 +140,8 @@ V_SMALL_WORLD_DEMOTION = "small-world-demotion"
 V_LIVE_DROPPED = "live-lease-dropped"
 V_DEMOTED_HOST_KEPT = "demoted-host-kept"
 V_RECOVER_MISMATCH = "recover-epoch-mismatch"
+V_RESHARD_EARLY_COMMIT = "reshard-early-commit"
+V_RESHARD_FALLBACK_MISSED = "reshard-fallback-missed"
 V_MODEL_ERROR = "model-error"
 
 RUNNABLE = "runnable"
@@ -137,6 +149,7 @@ WAITING = "waiting"
 FINISHED = "finished"
 
 _EPOCH_KEY = f"{DRIVER_SCOPE}/epoch"
+_RESHARD_COMMIT_KEY = f"{DRIVER_SCOPE}/reshard_commit"
 
 #: Reply sentinels: not-yet-served vs served-with-a-store-error.
 _PENDING = object()
@@ -167,7 +180,13 @@ def _fold_ops(state: Dict[str, bytes], ops) -> Dict[str, bytes]:
     code path, so a store-side mutant cannot bend both sides at once."""
     out = dict(state)
     for op in ops:
-        if op[0] == "set":
+        if op[0] == "check":
+            # CAS guard, evaluated against the overlay exactly as
+            # batch_steps does: a mismatch aborts the WHOLE batch, so
+            # its only legal post-state is the untouched pre-state.
+            if out.get(f"{op[1]}/{op[2]}") != op[3]:
+                return dict(state)
+        elif op[0] == "set":
             out[f"{op[1]}/{op[2]}"] = op[3]
         elif op[0] == "delete":
             out.pop(f"{op[1]}/{op[2]}", None)
@@ -296,6 +315,23 @@ def _driver_ticks(ex: "ProtoExecution", d: dict):
                 ex, _maybe_wrap(ex, "driver_recovery",
                                 outage_recovery_steps(scn.lease_timeout),
                                 d), d)
+        # Commit-probe of a pending reshard (production kernel, same tick
+        # position as ``_reshard_commit_probe``): reads the survivors'
+        # epoch acks over the wire, writes the commit record only when
+        # every one has adopted the epoch.
+        if scn.reshard and d.get("reshard_pending") is not None:
+            pend = d["reshard_pending"]
+            probe = _maybe_wrap(ex, "driver_reshard",
+                                reshard_commit_steps(pend["epoch"],
+                                                     pend["survivors"]), d)
+            try:
+                res = yield from _drive_kernel(ex, probe, d)
+            except _StoreDown:
+                d["outage"] = True
+                continue
+            pend["missing"] = res["missing"]
+            if res["committed"]:
+                d["reshard_pending"] = None
         # Phase boundary: worker posts may land between the fetch and the
         # judgment of its snapshot — the tick-vs-posts race under test.
         yield ("pause", "judge")
@@ -310,17 +346,61 @@ def _driver_ticks(ex: "ProtoExecution", d: dict):
             return  # violation recorded mid-judgment
         if j.get("advanced"):
             d["epoch"] += 1
-            ops: List[tuple] = [("set", DRIVER_SCOPE, "epoch",
-                                 str(d["epoch"]).encode())]
+            table = {}
             for ident in sorted(ex.slots):
                 rank, host = ex.slots[ident]
-                ops.append(("set", RANK_AND_SIZE_SCOPE, ident,
-                            json.dumps({"rank": rank, "epoch": d["epoch"],
-                                        "hostname": host}).encode()))
+                table[ident] = {"rank": rank, "epoch": d["epoch"],
+                                "hostname": host}
+            plan = None
+            if scn.reshard:
+                # The REAL plan kernel judges the publish about to go
+                # out — marker stamped into the same atomic transaction,
+                # fallback (no marker) while a previous reshard is still
+                # uncommitted, exactly as ``_rendezvous_epoch`` does.
+                plan = reshard_plan(
+                    table, set(d["known"]), enabled=True,
+                    pending=d.get("reshard_pending"),
+                    recent_joiners=d.get("last_joiners") or ())
+                if ex.mutation is not None \
+                        and ex.mutation.role == "driver_plan":
+                    plan = ex.mutation.wrap(plan, d)
+                if plan["fallback"]:
+                    d["reshard_pending"] = None
+                if plan["eligible"]:
+                    for slot in table.values():
+                        slot["reshard"] = True
+                        slot["sync_root"] = plan["sync_root"]
+                        slot["joiners"] = plan["joiners"]
+                        slot["survivors"] = plan["survivors"]
+            ops: List[tuple] = [("set", DRIVER_SCOPE, "epoch",
+                                 str(d["epoch"]).encode())]
+            ops.extend(("set", RANK_AND_SIZE_SCOPE, ident,
+                        json.dumps(table[ident]).encode())
+                       for ident in sorted(table))
+            if scn.reshard and plan["eligible"]:
+                # Armed BEFORE the publish, exactly as production: a
+                # store crash mid-service may land the marked table in
+                # the journal while losing only the ack, and an armed
+                # pending is safe either way — no marker on the wire
+                # means no survivor ack, so the commit never fires and
+                # the next advance falls back.
+                d["reshard_pending"] = {
+                    "epoch": d["epoch"],
+                    "survivors": plan["survivors"],
+                    "missing": list(plan["survivors"]),
+                }
+                d["last_joiners"] = set(plan["joiners"])
+            elif scn.reshard:
+                d["last_joiners"] = set()
             try:
                 yield ("send", tuple(ops), "advance_publish")
             except _StoreDown:
                 d["outage"] = True
+            else:
+                if scn.reshard:
+                    # Mirror the spawn loop: every ranked identity has a
+                    # live process after a successful publish.
+                    d["known"] = set(ex.slots)
 
 
 def _driver_proc(ex: "ProtoExecution"):
@@ -333,32 +413,67 @@ def _driver_recovery_proc(ex: "ProtoExecution"):
     d = ex.drv
     d["outage"] = False
     while True:
-        try:
-            rec = yield from _drive_kernel(
-                ex, _maybe_wrap(ex, "driver_recovery",
-                                recover_steps(ex.scenario.lease_timeout),
-                                d), d)
+        while True:
+            try:
+                rec = yield from _drive_kernel(
+                    ex, _maybe_wrap(ex, "driver_recovery",
+                                    recover_steps(ex.scenario.lease_timeout),
+                                    d), d)
+                break
+            except _StoreDown:
+                continue  # store died mid-recovery: retry, as production
+        if rec is None:
+            d["epoch"] = ex.scenario.epoch0
+            d["known"] = set(ex.slots)
+            d["lease_seen"] = {}
+            recovered_epoch = None
+        else:
+            served = ex.recover_epoch_served
+            truth = None if served is None else int(bytes(served).decode())
+            if truth is None or rec["epoch"] != truth:
+                ex._fail(V_RECOVER_MISMATCH,
+                         f"restarted driver adopted epoch {rec['epoch']}, "
+                         f"but the journal-backed store served {truth}")
+                return
+            d["epoch"] = rec["epoch"]
+            d["known"] = set(rec["adopted"])
+            d["lease_seen"] = {ident: (bytes(lease), ex.now)
+                               for ident, (_slot, lease)
+                               in sorted(rec["adopted"].items())}
+            recovered_epoch = rec["epoch"]
+        ex.last_recovery_at = ex.now
+        if not ex.scenario.reshard:
             break
+        # A reshard pending at crash time lived only in driver memory:
+        # the restarted driver knows nothing of it, and its initial
+        # republish (``start`` → ``_rendezvous_epoch(initial=True)``,
+        # never marker-eligible) overwrites the marked table with an
+        # unmarked one at the adopted epoch — driver crash mid-reshard
+        # degrades to the legacy path by construction.  The republish is
+        # CAS-fenced on the adopted epoch: the dead incarnation's
+        # in-flight publish may land AFTER our recovery read, and an
+        # unfenced republish would regress the durable epoch.  A lost
+        # fence means re-adopt and retry — exactly ``start()``'s loop.
+        d["reshard_pending"] = None
+        d["last_joiners"] = set()
+        expected = None if recovered_epoch is None \
+            else str(recovered_epoch).encode()
+        ops: List[tuple] = [
+            ("check", DRIVER_SCOPE, "epoch", expected),
+            ("set", DRIVER_SCOPE, "epoch", str(d["epoch"]).encode())]
+        ops.extend(("set", RANK_AND_SIZE_SCOPE, ident,
+                    json.dumps({"rank": ex.slots[ident][0],
+                                "epoch": d["epoch"],
+                                "hostname": ex.slots[ident][1]}).encode())
+                   for ident in sorted(ex.slots))
+        try:
+            res = yield ("send", tuple(ops), "recover_publish")
         except _StoreDown:
-            continue  # store died mid-recovery: retry, as production does
-    if rec is None:
-        d["epoch"] = ex.scenario.epoch0
-        d["known"] = set(ex.slots)
-        d["lease_seen"] = {}
-    else:
-        served = ex.recover_epoch_served
-        truth = None if served is None else int(bytes(served).decode())
-        if truth is None or rec["epoch"] != truth:
-            ex._fail(V_RECOVER_MISMATCH,
-                     f"restarted driver adopted epoch {rec['epoch']}, but "
-                     f"the journal-backed store served {truth}")
-            return
-        d["epoch"] = rec["epoch"]
-        d["known"] = set(rec["adopted"])
-        d["lease_seen"] = {ident: (bytes(lease), ex.now)
-                           for ident, (_slot, lease)
-                           in sorted(rec["adopted"].items())}
-    ex.last_recovery_at = ex.now
+            d["outage"] = True
+            break
+        if res and res[0] is False:
+            continue  # fence lost: the epoch moved under us; re-adopt
+        break
     yield from _driver_ticks(ex, d)
 
 
@@ -377,6 +492,33 @@ def _worker_proc(ex: "ProtoExecution", spec: dict):
             ops = [("set", RESET_REQUEST_SCOPE, spec["identity"],
                     reset_request_payload(item[1], item[2]))]
             tag = "reset_request"
+        elif item[0] == "ack":
+            # Epoch-adoption ack, the exact write a survivor's
+            # ``refresh_topology_from_rendezvous`` makes after ADOPTING
+            # a published epoch — never before.  The one-shot poll
+            # models the refresh's blocking read of the slot table: a
+            # survivor only acks an epoch it has OBSERVED published.
+            # Acking unconditionally would be a fidelity bug — it lets
+            # the model commit a reshard whose marked publish never
+            # landed, a schedule no real worker can produce.
+            try:
+                res = yield ("send",
+                             (("get", RANK_AND_SIZE_SCOPE,
+                               spec["identity"]),), "epoch_poll")
+            except _StoreDown:
+                continue
+            raw = res[0] if res else None
+            if raw is None:
+                continue
+            try:
+                observed = json.loads(bytes(raw).decode()).get("epoch", -1)
+            except (ValueError, TypeError):
+                continue
+            if observed < item[1]:
+                continue  # publish not visible yet: no adoption, no ack
+            ops = [("set", EPOCH_ACK_SCOPE, spec["identity"],
+                    str(observed).encode())]
+            tag = "epoch_ack"
         else:
             raise AssertionError(f"unknown worker script item {item!r}")
         try:
@@ -444,6 +586,13 @@ class ProtoExecution:
         self._fold_keys: Set[frozenset] = {frozenset()}
         self.true_tick_reply: Optional[Tuple[tuple, tuple]] = None
         self.recover_epoch_served: Optional[bytes] = None
+        # Store-side reshard ledger (ground truth for the reshard
+        # invariants, rebuilt from replayed durable state on a store
+        # crash): marked-published epochs awaiting their commit record,
+        # with the survivor set each one published, and epochs whose
+        # commit landed.
+        self.reshard_pending_store: Dict[int, FrozenSet[str]] = {}
+        self.reshard_committed: Set[int] = set()
 
         # topology ground truth
         self.slots: Dict[str, Tuple[int, str]] = dict(scenario.slots)
@@ -474,6 +623,7 @@ class ProtoExecution:
         self.drv: dict = {
             "epoch": scenario.epoch0, "tick": 0, "outage": False,
             "grace": 0.0, "known": set(self.slots), "lease_seen": {},
+            "reshard_pending": None, "last_joiners": set(),
         }
 
         self.procs: Dict[str, _Proc] = {"drv": _Proc(_driver_proc(self))}
@@ -764,13 +914,95 @@ class ProtoExecution:
                        f"{self.scenario.active_np} (<= 2): the whole-"
                        "world-slow guard should make this structurally "
                        "impossible")
+        if flat.startswith(f"{RANK_AND_SIZE_SCOPE}/"):
+            self._apply_slot_doc(flat, value, req)
+        if flat == _RESHARD_COMMIT_KEY:
+            self._apply_reshard_commit(value, req)
         self.data[flat] = value
 
+    def _apply_slot_doc(self, flat: str, value: bytes, req: _Req) -> None:
+        """Reshard ledger + fallback invariant on every published slot
+        entry.  A MARKED entry landing at epoch E while an older marked
+        epoch never committed is the load-bearing fallback deleted: the
+        workers of the failed reshard (some possibly holding blank,
+        never-synced state) would be strung along as survivors instead
+        of degraded to the legacy full-sync path.  An UNMARKED entry at
+        epoch >= E *is* that fallback and retires E."""
+        try:
+            doc = json.loads(bytes(value).decode())
+        except (ValueError, TypeError):
+            return
+        if not isinstance(doc, dict) or not isinstance(doc.get("epoch"),
+                                                       int):
+            return
+        ep = doc["epoch"]
+        if doc.get("reshard"):
+            stale = sorted(e for e in self.reshard_pending_store if e < ep)
+            if stale:
+                self._fail(
+                    V_RESHARD_FALLBACK_MISSED,
+                    f"reshard-marked slot table published at epoch {ep} "
+                    f"(txn {req.tag!r}) while the epoch-{stale[0]} "
+                    "reshard never committed: the fallback to the "
+                    "legacy full-teardown path was skipped")
+            self.reshard_pending_store[ep] = frozenset(
+                doc.get("survivors") or ())
+        else:
+            for e in [e for e in self.reshard_pending_store if e <= ep]:
+                del self.reshard_pending_store[e]
+
+    def _apply_reshard_commit(self, value: bytes, req: _Req) -> None:
+        """Early-commit invariant, judged on the STORE's own data: when
+        the commit record for epoch E lands, every survivor the marked
+        publish listed must already have an epoch ack >= E on record —
+        the driver-side guard a mutant deletes cannot bend this."""
+        try:
+            ep = int(bytes(value).decode())
+        except ValueError:
+            self._fail(V_MODEL_ERROR,
+                       f"unparseable reshard commit record {value!r}")
+            return
+        survivors = self.reshard_pending_store.get(ep)
+        if survivors is None:
+            if ep not in self.reshard_committed:
+                self._fail(
+                    V_RESHARD_EARLY_COMMIT,
+                    f"reshard commit record for epoch {ep} (txn "
+                    f"{req.tag!r}) with no marked publish pending at "
+                    "that epoch")
+            return
+        unacked = []
+        for ident in sorted(survivors):
+            raw = self.data.get(f"{EPOCH_ACK_SCOPE}/{ident}")
+            try:
+                acked = int(bytes(raw).decode()) if raw is not None else -1
+            except ValueError:
+                acked = -1
+            if acked < ep:
+                unacked.append(ident)
+        if unacked:
+            self._fail(
+                V_RESHARD_EARLY_COMMIT,
+                f"reshard commit for epoch {ep} landed with survivor(s) "
+                f"{unacked} never having acked it: an in-place "
+                "re-rendezvous was declared done over workers that may "
+                "still be running the old topology")
+            return
+        del self.reshard_pending_store[ep]
+        self.reshard_committed.add(ep)
+
     def _serve_reply(self, req: _Req, results: tuple) -> None:
-        for op in req.ops:
-            if op[0] == "set":
-                self.acked_sets.append(
-                    (f"{op[1]}/{op[2]}", op[3], req.tag))
+        # A batch aborted by a failed CAS ``check`` journals and applies
+        # NOTHING — its sets were never promised, so recording them as
+        # acked would manufacture a false V_ACKED_LOST.
+        aborted = any(op[0] == "check" and idx < len(results)
+                      and results[idx] is False
+                      for idx, op in enumerate(req.ops))
+        if not aborted:
+            for op in req.ops:
+                if op[0] == "set":
+                    self.acked_sets.append(
+                        (f"{op[1]}/{op[2]}", op[3], req.tag))
         p = self.procs.get(req.client)
         current = p is not None and p.token == req.token
         if current and req.client == "drv":
@@ -803,6 +1035,37 @@ class ProtoExecution:
                 p.reply = _ERROR
         # Restart: state is whatever the journal's valid prefix replays.
         self.data = _replay(self.journal)
+        self._rebuild_reshard_ledger()
+
+    def _rebuild_reshard_ledger(self) -> None:
+        """Re-derive the reshard ledger from replayed durable state: a
+        marked epoch is pending iff its marked entries are still the
+        latest for some identity (an unmarked/later publish overwrote
+        them — the retirement the incremental path applies) and its
+        commit record is absent."""
+        pending: Dict[int, FrozenSet[str]] = {}
+        for flat, value in self.data.items():
+            if not flat.startswith(f"{RANK_AND_SIZE_SCOPE}/"):
+                continue
+            try:
+                doc = json.loads(bytes(value).decode())
+            except (ValueError, TypeError):
+                continue
+            if isinstance(doc, dict) and doc.get("reshard") \
+                    and isinstance(doc.get("epoch"), int):
+                pending[doc["epoch"]] = frozenset(
+                    doc.get("survivors") or ())
+        committed = set(self.reshard_committed)
+        raw = self.data.get(_RESHARD_COMMIT_KEY)
+        if raw is not None:
+            try:
+                committed.add(int(bytes(raw).decode()))
+            except ValueError:
+                pass
+        for ep in committed:
+            pending.pop(ep, None)
+        self.reshard_pending_store = pending
+        self.reshard_committed = committed
 
     def _crash_driver(self) -> None:
         self.driver_crashes_used += 1

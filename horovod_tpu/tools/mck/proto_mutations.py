@@ -19,13 +19,20 @@ from __future__ import annotations
 import json
 from typing import Dict
 
-from ...elastic.driver import STEP_BLACKLIST, STEP_GRACE, STEP_POLL_HOSTS
+from ...elastic.driver import (
+    STEP_BLACKLIST,
+    STEP_GRACE,
+    STEP_POLL_HOSTS,
+    STEP_TXN,
+)
 from ...transport.store import STEP_JOURNAL, STEP_REPLY
 from .mutations import Mutation
 from .proto_model import (
     V_ACKED_LOST,
     V_DEMOTED_HOST_KEPT,
     V_LIVE_DROPPED,
+    V_RESHARD_EARLY_COMMIT,
+    V_RESHARD_FALLBACK_MISSED,
     V_STALE_ACTED,
     V_TORN_GROUP,
 )
@@ -125,6 +132,40 @@ def _blacklist_after_poll(gen, ctx):
         resp = yield step
 
 
+def _reshard_commit_unguarded(gen, ctx):
+    """Forge every fetched survivor epoch-ack to the pending epoch —
+    equivalent to deleting the acked-at-epoch guard from
+    ``reshard_commit_steps``.  The commit record lands the moment the
+    probe runs; the store's ground-truth acks are still real, so the
+    early commit is caught server-side."""
+    resp = None
+    while True:
+        try:
+            step = gen.send(resp)
+        except StopIteration as fin:
+            return fin.value
+        resp = yield step
+        if step[0] == STEP_TXN and step[2] == "reshard_acks":
+            epoch = ctx["reshard_pending"]["epoch"]
+            resp = [str(epoch).encode() for _ in resp]
+
+
+def _reshard_fallback_dropped(plan, ctx):
+    """Delete the legacy-fallback branch from the publish plan: the
+    marker is kept even while a previous reshard sits uncommitted, so
+    survivors of the failed reshard — possibly holding blank,
+    never-synced state — are strung along instead of degraded to the
+    full-teardown path.  NOTE: role ``driver_plan`` wraps the plan DICT
+    (not a generator) — the model applies it to ``reshard_plan``'s
+    return value at each publish."""
+    if not plan["fallback"]:
+        return plan
+    out = dict(plan)
+    out["fallback"] = False
+    out["eligible"] = bool(out["survivors"])
+    return out
+
+
 def _regrace_dropped(gen, ctx):
     """Swallow the re-grace arm after a store outage: replayed leases
     read as last-renewed before the outage, so a live worker whose
@@ -176,4 +217,18 @@ PROTO_MUTATIONS: Dict[str, Mutation] = {m.name: m for m in (
         description="lease re-grace window dropped after store-outage "
                     "recovery",
         wrap=_regrace_dropped),
+    Mutation(
+        "reshard_commit_unguarded", role="driver_reshard",
+        scenario="reshard_commit",
+        expected=frozenset({V_RESHARD_EARLY_COMMIT}),
+        description="survivor epoch-acks forged at the commit probe "
+                    "(all-survivors-acked guard deleted)",
+        wrap=_reshard_commit_unguarded),
+    Mutation(
+        "reshard_fallback_dropped", role="driver_plan",
+        scenario="reshard_fallback",
+        expected=frozenset({V_RESHARD_FALLBACK_MISSED}),
+        description="reshard marker kept while a previous reshard is "
+                    "still uncommitted (legacy-fallback branch deleted)",
+        wrap=_reshard_fallback_dropped),
 )}

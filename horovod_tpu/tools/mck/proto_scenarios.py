@@ -36,7 +36,7 @@ class ProtoScenario:
     __slots__ = ("name", "description", "preemptions", "ticks", "epoch0",
                  "lease_timeout", "slots", "workers", "coordinator",
                  "seeds", "clock_steps", "store_crashes", "driver_crashes",
-                 "active_np")
+                 "active_np", "reshard")
 
     def __init__(self, name: str, description: str, preemptions: int,
                  ticks: int, slots: Dict[str, Tuple[int, str]],
@@ -46,7 +46,8 @@ class ProtoScenario:
                  seeds: Optional[List[List[tuple]]] = None,
                  clock_steps: Optional[List[float]] = None,
                  store_crashes: int = 0, driver_crashes: int = 0,
-                 active_np: Optional[int] = None):
+                 active_np: Optional[int] = None,
+                 reshard: bool = False):
         self.name = name
         self.description = description
         self.preemptions = preemptions
@@ -61,6 +62,11 @@ class ProtoScenario:
         self.store_crashes = store_crashes
         self.driver_crashes = driver_crashes
         self.active_np = len(slots) if active_np is None else active_np
+        # Zero-restart resharding enabled for the model driver: epoch
+        # publishes run the real reshard_plan (marker / fallback) and
+        # each tick probes reshard_commit_steps.  Off by default so the
+        # PR-18 scenarios keep their exact proven state spaces.
+        self.reshard = reshard
 
 
 def _lease_seed(identity: str, rank: int, epoch: int) -> tuple:
@@ -192,4 +198,62 @@ PROTO_SCENARIOS: Dict[str, ProtoScenario] = {s.name: s for s in (
             [_slot_seed("h1:0", 1, 0, "h1"), _lease_seed("h1:0", 1, 0)],
         ],
         driver_crashes=1),
+    ProtoScenario(
+        "reshard_commit",
+        "zero-restart reshard round-trip with a store crash explored at "
+        "every step: one worker goes silent and expires after a clock "
+        "jump, the advance publishes the reshard-marked table, the "
+        "survivor acks the epoch, and the driver's commit probe may "
+        "write the commit record ONLY once every survivor's ack is on "
+        "record (publish -> survivor-ack -> topology-commit)",
+        preemptions=2, ticks=3, lease_timeout=10.0,
+        slots={"h0:0": (0, "h0"), "h1:0": (1, "h1")},
+        workers=[
+            {"name": "w0", "identity": "h0:0", "rank": 0, "epoch": 0,
+             "script": [("renew",), ("ack", 1)]},
+        ],
+        seeds=[
+            [_slot_seed("h0:0", 0, 0, "h0"), _lease_seed("h0:0", 0, 0)],
+            [_slot_seed("h1:0", 1, 0, "h1"), _lease_seed("h1:0", 1, 0)],
+        ],
+        clock_steps=[11.0], store_crashes=1, reshard=True),
+    ProtoScenario(
+        "reshard_driver_crash",
+        "the driver may crash at any step of a reshard (before the "
+        "marked publish, between publish and commit, after commit) and "
+        "restart through recover_steps: the pending reshard dies with "
+        "the driver's memory and the recovery republish (unmarked, at "
+        "the adopted epoch) must retire it — a crashed driver degrades "
+        "the reshard to the legacy path, never strings survivors along",
+        preemptions=2, ticks=3, lease_timeout=10.0,
+        slots={"h0:0": (0, "h0"), "h1:0": (1, "h1")},
+        workers=[
+            {"name": "w0", "identity": "h0:0", "rank": 0, "epoch": 0,
+             "script": [("renew",), ("ack", 1)]},
+        ],
+        seeds=[
+            [_slot_seed("h0:0", 0, 0, "h0"), _lease_seed("h0:0", 0, 0)],
+            [_slot_seed("h1:0", 1, 0, "h1"), _lease_seed("h1:0", 1, 0)],
+        ],
+        clock_steps=[11.0], driver_crashes=1, reshard=True),
+    ProtoScenario(
+        "reshard_fallback",
+        "a survivor crashes mid-reshard (its epoch ack never lands "
+        "before a current-epoch reset forces the next advance): the "
+        "still-pending reshard must drop the marker from the next "
+        "publish — the degradation to the legacy full-teardown path is "
+        "load-bearing, survivors of a failed reshard may hold blank "
+        "never-synced state",
+        preemptions=2, ticks=3, lease_timeout=10.0,
+        slots={"h0:0": (0, "h0"), "h1:0": (1, "h1")},
+        workers=[
+            {"name": "w0", "identity": "h0:0", "rank": 0, "epoch": 0,
+             "script": [("renew",), ("reset", 1, "peer hard-crash"),
+                        ("ack", 1)]},
+        ],
+        seeds=[
+            [_slot_seed("h0:0", 0, 0, "h0"), _lease_seed("h0:0", 0, 0)],
+            [_slot_seed("h1:0", 1, 0, "h1"), _lease_seed("h1:0", 1, 0)],
+        ],
+        clock_steps=[11.0], reshard=True),
 )}

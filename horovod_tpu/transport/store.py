@@ -86,7 +86,16 @@ def batch_steps(ops: List[tuple]):
     record, then apply — journal strictly before the first apply, reply
     strictly after the last.  Crash-at-any-yield plus truncating replay
     keeps this atomic: the group frame either fully made the journal (all
-    mutations replay) or it didn't (none do); there is no prefix."""
+    mutations replay) or it didn't (none do); there is no prefix.
+
+    ``("check", scope, key, expected)`` guards the whole batch: if the
+    key's current value (``expected=None`` = must be absent) does not
+    match, NOTHING journals or applies and the reply carries ``False``
+    at the check's position with no further ops evaluated.  This is the
+    fencing a restarted elastic driver's recovery republish needs — a
+    crashed incarnation's in-flight epoch publish landing between the
+    new driver's recovery read and its republish must fail the
+    republish, not be silently overwritten with a stale epoch."""
     from .journal import OP_DELETE, OP_SET
 
     overlay: Dict[str, object] = {}
@@ -95,7 +104,19 @@ def batch_steps(ops: List[tuple]):
     any_set = False
     for op in ops:
         kind = op[0]
-        if kind == "set":
+        if kind == "check":
+            _, scope, key, expected = op
+            flat = f"{scope}/{key}"
+            if flat in overlay:
+                v = overlay[flat]
+                actual = None if v is _TOMBSTONE else v
+            else:
+                actual = yield (STEP_LOAD, flat)
+            if actual != expected:
+                yield (STEP_REPLY, tuple(results) + (False,))
+                return results + [False]
+            results.append(True)
+        elif kind == "set":
             _, scope, key, value = op
             flat = f"{scope}/{key}"
             overlay[flat] = value
@@ -154,6 +175,11 @@ def encode_batch_ops(ops: List[tuple]) -> bytes:
         if kind == "set":
             out.append({"op": "set", "scope": op[1], "key": op[2],
                         "value": base64.b64encode(op[3]).decode("ascii")})
+        elif kind == "check":
+            item = {"op": "check", "scope": op[1], "key": op[2]}
+            if op[3] is not None:  # absent "value" = key must not exist
+                item["value"] = base64.b64encode(op[3]).decode("ascii")
+            out.append(item)
         elif kind in ("get", "delete"):
             out.append({"op": kind, "scope": op[1], "key": op[2]})
         elif kind == "keys":
@@ -171,6 +197,10 @@ def decode_batch_ops(body: bytes) -> List[tuple]:
         if kind == "set":
             ops.append(("set", item["scope"], item["key"],
                         base64.b64decode(item["value"])))
+        elif kind == "check":
+            expected = base64.b64decode(item["value"]) \
+                if "value" in item else None
+            ops.append(("check", item["scope"], item["key"], expected))
         elif kind in ("get", "delete"):
             ops.append((kind, item["scope"], item["key"]))
         elif kind == "keys":
@@ -230,7 +260,15 @@ class Store:
         results: List[object] = []
         for op in ops:
             kind = op[0]
-            if kind == "set":
+            if kind == "check":
+                # Best-effort on the per-op compatibility path (no
+                # atomicity to protect, but the stop-on-failure contract
+                # holds: nothing after a failed guard executes).
+                if self.get(op[1], op[2]) != op[3]:
+                    results.append(False)
+                    return results
+                results.append(True)
+            elif kind == "set":
                 self.set(op[1], op[2], op[3])
                 results.append(True)
             elif kind == "get":

@@ -6,6 +6,7 @@ discovery, in-process); the integration tier mirrors
 job against a mutable hosts file, asserting recovery invariants from worker
 logs."""
 
+import copy
 import os
 import subprocess
 import sys
@@ -140,6 +141,99 @@ def test_object_state_commit_restore():
     state.epoch = 9
     state.restore()
     assert state.epoch == 7
+
+
+def test_object_state_sync_adopts_roots_attribute_set(monkeypatch):
+    """Live-reshard joiner edge: a joiner whose constructor defaults
+    differ from the coordinator's evolved attribute set must adopt the
+    ROOT's set — values AND keys — or its next save/restore cycle
+    snapshots keys nobody else agrees on."""
+    from horovod_tpu.frameworks.jax import functions as jax_fns
+
+    root_payload = {"a": 10, "c": [3, 4]}  # root dropped b, grew c
+
+    def fake_broadcast(values, root_rank=0, name=""):
+        assert set(values) == {"a", "b"}  # the joiner offered its own set
+        return {k: copy.deepcopy(v) for k, v in root_payload.items()}
+
+    monkeypatch.setattr(jax_fns, "broadcast_object", fake_broadcast)
+    joiner = ObjectState(a=1, b=2)
+    joiner.sync(root_rank=0)
+    assert joiner._known == ["a", "c"]
+    assert joiner.a == 10 and joiner.c == [3, 4]
+    # The adopted set is committed: a dirty restore comes back to the
+    # ROOT's state, and b is no longer part of any snapshot.
+    joiner.a = 99
+    joiner.c.append(5)
+    joiner.restore()
+    assert joiner.a == 10 and joiner.c == [3, 4]
+    assert "b" not in joiner._saved
+
+
+def test_object_state_restore_after_failed_mid_sync_broadcast(monkeypatch):
+    """A broadcast that dies mid-sync (the reshard it rode aborted, a
+    peer vanished) must leave the last committed snapshot intact:
+    restore() lands bit-exact on the pre-sync commit, and a later
+    successful sync proceeds from there."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.frameworks.jax import functions as jax_fns
+
+    state = ObjectState(batch=7, params=[1.0, 2.0])
+    state.commit()
+
+    def dying_broadcast(values, root_rank=0, name=""):
+        raise HorovodInternalError("peer gone mid-broadcast")
+
+    monkeypatch.setattr(jax_fns, "broadcast_object", dying_broadcast)
+    with pytest.raises(HorovodInternalError):
+        state.sync()
+    state.restore()
+    assert state.batch == 7 and state.params == [1.0, 2.0]
+    assert state._known == ["batch", "params"]
+
+    def good_broadcast(values, root_rank=0, name=""):
+        return {k: copy.deepcopy(v) for k, v in values.items()}
+
+    monkeypatch.setattr(jax_fns, "broadcast_object", good_broadcast)
+    state.sync()
+    assert state.batch == 7 and state.params == [1.0, 2.0]
+
+
+def test_object_state_commit_restore_idempotent_across_epochs():
+    """Two epoch transitions' worth of commit/restore churn: repeated
+    restores of the same commit are idempotent, and a re-commit of an
+    unmodified state changes nothing — the retry loop in elastic.run may
+    restore more than once per epoch and must always land on the same
+    bits."""
+    state = ObjectState(batch=0, acc=[0])
+    # Epoch 1: some progress, committed.
+    state.batch = 10
+    state.acc.append(1)
+    state.commit()
+    snap1 = (state.batch, list(state.acc))
+    state.batch = 11  # uncommitted progress, then two restores
+    state.restore()
+    first = (state.batch, list(state.acc))
+    state.restore()
+    assert first == (state.batch, list(state.acc)) == snap1
+    # Re-commit without modification: still the same snapshot.
+    state.commit()
+    state.restore()
+    assert (state.batch, list(state.acc)) == snap1
+    # Epoch 2: more progress on top of the restored state.
+    state.batch = 20
+    state.acc.append(2)
+    state.commit()
+    snap2 = (state.batch, list(state.acc))
+    state.batch = 99
+    state.acc.clear()
+    state.restore()
+    state.restore()
+    assert (state.batch, list(state.acc)) == snap2
+    # deepcopy discipline: the snapshot must not alias live objects.
+    state.acc.append(3)
+    state.restore()
+    assert (state.batch, list(state.acc)) == snap2
 
 
 _ELASTIC_TRAIN = """

@@ -892,6 +892,143 @@ def test_one_shot_straggle_flags_but_does_not_demote(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# zero-restart elastic resharding (docs/elastic.md "Live resharding")
+# ---------------------------------------------------------------------------
+
+# Crash limit raised over the default of 1 so the SIGKILL'd victim's host
+# is NOT shed: its identity must come back as a JOINER of the resharded
+# epoch (exercising the sync_root broadcast), not vanish with the host.
+# min_np == np below pins the world size, so the averaging-allreduce
+# bit-identity argument needs no size-change caveat.
+_RESHARD_KNOBS = {
+    "HOROVOD_ELASTIC_CRASH_FAILURE_LIMIT": "5",
+    "HOROVOD_LOCK_DEBUG": "1",
+}
+
+
+# The victim's fault must fire ONCE per job, not once per process: the
+# respawned joiner inherits HOROVOD_FAULT_SPEC and would kill itself
+# again every nth collectives until the host blacklists.  Each identity
+# marks its first incarnation with a flag file keyed on
+# HOROVOD_LOCAL_RANK (set per slot by the launcher, readable before
+# hvd.init); a REspawned incarnation finds its own flag and disarms the
+# spec before the faults registry parses it at import.
+_RESHARD_DISARM_PREAMBLE = """
+import os
+_flag = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "spawned_%s" % os.environ.get("HOROVOD_LOCAL_RANK"))
+if os.path.exists(_flag):
+    os.environ.pop("HOROVOD_FAULT_SPEC", None)
+else:
+    open(_flag, "w").close()
+"""
+
+
+def _run_reshard_job(tmp_path, fault_spec, extra_env=None):
+    """np=8 elastic job on ONE loopback host (8 slots).  Returns
+    (rank->params map, proc)."""
+    disc = tmp_path / "discover8.sh"
+    disc.write_text("#!/bin/sh\necho localhost:8\n")
+    disc.chmod(0o755)
+    arm = "fault" if fault_spec else "clean"
+    jobdir = tmp_path / arm
+    jobdir.mkdir()
+    train = jobdir / "train.py"
+    train.write_text(_RESHARD_DISARM_PREAMBLE + _ELASTIC_DEMOTION_TRAIN)
+
+    env = os.environ.copy()
+    env.update(_FAST_DEADLINE)
+    env.update(_RESHARD_KNOBS)
+    env.update(extra_env or {})
+    env["HOROVOD_LOG_LEVEL"] = "info"  # driver logs publish/commit/fallback
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    if fault_spec:
+        env["HOROVOD_FAULT_SPEC"] = fault_spec
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "8", "--min-np", "8",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        capture_output=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])
+    params = dict(re.findall(r"FINAL_PARAMS r(\d+) ([0-9a-f]+)",
+                             proc.stdout))
+    assert params, proc.stdout[-2000:]
+    assert len(set(params.values())) == 1, "ranks diverged"
+    return params, proc
+
+
+def _spawns_by_epoch(stderr):
+    """[(identity, epoch), ...] from the driver's spawn log lines."""
+    return [(ident, int(ep)) for ident, ep in
+            re.findall(r"spawning worker (\S+) \(epoch (\d+)", stderr)]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_live_reshard_np8_survivors_keep_processes_joiner_syncs(tmp_path):
+    """The tentpole end to end at np=8: rank 3 is SIGKILL'd mid-train, the
+    driver publishes the next assignment with the reshard marker, the 7
+    survivors abort their in-flight collectives and re-rendezvous IN PLACE
+    (the driver spawns exactly one post-churn process: the victim's
+    identity, back as a joiner), the joiner receives mid-training state
+    over the sync_root broadcast — this job has no checkpointing at all,
+    so the joiner finishing bit-identical IS the proof the state came over
+    collectives — and the commit record lands only after every survivor
+    acked the new epoch."""
+    clean, _ = _run_reshard_job(tmp_path, None)
+    assert set(clean) == {str(r) for r in range(8)}
+    faulted, proc = _run_reshard_job(
+        tmp_path, "dispatch.collective:rank=3:nth=8:action=exit,9")
+    assert set(faulted) == {str(r) for r in range(8)}, proc.stdout[-2000:]
+    assert faulted["0"] == clean["0"], \
+        "resharded run did not converge to the no-churn run"
+    # The reshard protocol ran — marked publish, then the commit that
+    # requires every survivor's ack — and never degraded to the legacy
+    # full-teardown path.
+    assert "published with reshard marker" in proc.stderr, \
+        proc.stderr[-3000:]
+    assert "reshard committed at epoch" in proc.stderr, proc.stderr[-3000:]
+    assert "falls back to the full-teardown path" not in proc.stderr, \
+        proc.stderr[-3000:]
+    # Zero restarts for survivors: 8 spawns at epoch 0, then exactly ONE
+    # post-churn spawn, and it is the victim's identity.
+    spawns = _spawns_by_epoch(proc.stderr)
+    initial = [ident for ident, ep in spawns if ep == 0]
+    later = [ident for ident, ep in spawns if ep > 0]
+    assert len(initial) == 8, spawns
+    assert later == ["localhost:3"], \
+        f"survivors were respawned (or the victim was not): {spawns}"
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_live_reshard_kill_switch_falls_back_and_still_converges(tmp_path):
+    """HOROVOD_RESHARD=0 is the operator kill-switch: the same SIGKILL
+    churn must publish NO reshard marker and write NO commit record — the
+    job recovers on the legacy path (survivors ride out the progress
+    deadline instead of the prompt abort) and still converges
+    bit-identical.  The fallback is load-bearing: this is also the path a
+    wedged reshard degrades to."""
+    clean, _ = _run_reshard_job(tmp_path, None,
+                                extra_env={"HOROVOD_RESHARD": "0"})
+    faulted, proc = _run_reshard_job(
+        tmp_path, "dispatch.collective:rank=3:nth=8:action=exit,9",
+        extra_env={"HOROVOD_RESHARD": "0"})
+    assert set(faulted) == {str(r) for r in range(8)}, proc.stdout[-2000:]
+    assert faulted["0"] == clean["0"]
+    assert "published with reshard marker" not in proc.stderr, \
+        proc.stderr[-3000:]
+    assert "reshard committed" not in proc.stderr, proc.stderr[-3000:]
+    # The legacy path also keeps survivor processes: only the victim's
+    # identity is respawned.  What the kill-switch changes is the abort
+    # latency and the sync discipline, not the process-lifetime contract.
+    later = [ident for ident, ep in _spawns_by_epoch(proc.stderr) if ep > 0]
+    assert later == ["localhost:3"], proc.stderr[-3000:]
+
+
+# ---------------------------------------------------------------------------
 # control-plane survivability (docs/control_plane.md)
 # ---------------------------------------------------------------------------
 

@@ -192,3 +192,113 @@ def test_sim_demotion_np128_artifact(monkeypatch):
         f.write(json.dumps(rec) + "\n")
     with open(out) as f:
         assert json.loads(f.read()) == rec
+
+# ---------------------------------------------------------------------------
+# zero-restart reshard lane (docs/elastic.md "Live resharding")
+
+
+def test_sim_reshard_schedule_and_digest_deterministic():
+    a = SimCluster(64, slots_per_host=8, seed=42, trace=False)
+    b = SimCluster(64, slots_per_host=8, seed=42, trace=False)
+    other = SimCluster(64, slots_per_host=8, seed=43, trace=False)
+    assert a.reshard_schedule(4) == b.reshard_schedule(4)
+    assert a.reshard_digest(4) == b.reshard_digest(4)
+    assert a.reshard_digest(4) != other.reshard_digest(4)
+    # The reshard lane shares nothing with the churn or demotion
+    # schedules: asking for it must not perturb their digests.
+    assert a.determinism_digest(6) == \
+        SimCluster(64, slots_per_host=8, seed=42,
+                   trace=False).determinism_digest(6)
+    assert a.demotion_digest(3) == \
+        SimCluster(64, slots_per_host=8, seed=42,
+                   trace=False).demotion_digest(3)
+
+
+def test_sim_reshard_np16(monkeypatch):
+    """A preemption kill through the REAL driver at np=16: lease expiry,
+    reshard-marked publish, survivor acks, commit record, cause=reshard
+    transition, zero fallbacks — the np=512 artifact run is the same
+    runner via ``python -m horovod_tpu.sim --reshards``."""
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    monkeypatch.delenv("HOROVOD_RESHARD", raising=False)
+    cluster = SimCluster(16, slots_per_host=8, seed=7, lease_timeout=1.0,
+                         renew_period=0.2)
+    rec = cluster.run_reshard(kills=1)
+    assert rec["metric"] == "sim_reshard"
+    assert rec["np"] == 16 and rec["reshard_enabled"] is True
+    assert rec["final_epoch"] == 1
+    (event,) = rec["events"]
+    assert event["marked"] is True
+    assert event["victim"] == rec["determinism"]["schedule"][0]
+    assert 0 < event["kill_to_epoch_ms"] <= event["kill_to_commit_ms"] \
+        <= event["kill_to_first_round_ms"]
+    assert rec["driver_reshard_transitions"] == 1
+    assert rec["reshard_fallbacks"] == 0
+    assert rec["attribution"]["coverage"] >= 0.90, rec["attribution"]
+    assert rec["determinism"]["digest"] == SimCluster(
+        16, slots_per_host=8, seed=7, trace=False).reshard_digest(1)
+    json.dumps(rec)  # artifact must be JSON-serializable as-is
+
+
+def test_sim_reshard_kill_switch_baseline_arm(monkeypatch):
+    """HOROVOD_RESHARD=0 is the committed A/B's baseline arm: the same
+    kill advances the epoch with NO marker, NO pending commit, and NO
+    cause=reshard transition."""
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    monkeypatch.setenv("HOROVOD_RESHARD", "0")
+    cluster = SimCluster(16, slots_per_host=8, seed=7, lease_timeout=1.0,
+                         renew_period=0.2, trace=False)
+    rec = cluster.run_reshard(kills=1)
+    assert rec["reshard_enabled"] is False
+    assert rec["final_epoch"] == 1
+    assert rec["events"][0]["marked"] is False
+    assert rec["driver_reshard_transitions"] == 0
+    assert rec["reshard_fallbacks"] == 0
+
+
+def test_sim_reshard_respects_min_np_quorum_during_demotion(monkeypatch):
+    """Reshard/demotion interplay regression: a demotion that lands the
+    world exactly AT quorum advances (and, with resharding on, rides the
+    reshard path as a pure shrink); churn that would take it BELOW
+    ``min_np`` must park the driver at the capacity gate — the epoch
+    holds and no reshard is ever armed for a sub-quorum world."""
+    import time
+
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    monkeypatch.delenv("HOROVOD_RESHARD", raising=False)
+    cluster = SimCluster(4, slots_per_host=1, seed=7, lease_timeout=1.0,
+                         renew_period=0.2, trace=False, min_np=3)
+    assert cluster.min_np == 3
+    cluster.start()
+    try:
+        for _ in range(2):
+            cluster.renewal_round()
+            time.sleep(cluster.renew_period)
+        # Demotion to exactly min_np: allowed, and the advance is a
+        # reshard-marked pure shrink (no joiners) that commits.
+        target = cluster.driver.epoch + 1
+        victim_host = cluster.hostnames[1]
+        cluster.inject_demotion(victim_host)
+        cluster.await_epoch(target, timeout=30.0)
+        assert cluster.driver._reshard_pending is not None
+        cluster.ack_round(cluster.driver.epoch)
+        for w in cluster.workers.values():
+            if w.hostname == victim_host:
+                w.renewing = False
+        cluster.await_reshard_commit(timeout=30.0)
+        # Second demotion would leave 2 < min_np=3: the capacity gate
+        # must hold the epoch and never arm a reshard.
+        epoch_at_quorum = cluster.driver.epoch
+        cluster.inject_demotion(cluster.hostnames[2])
+        deadline = time.monotonic() + 4 * cluster.lease_timeout
+        while time.monotonic() < deadline:
+            cluster.renewal_round()
+            cluster.driver._wakeup.set()
+            time.sleep(cluster.renew_period)
+        assert cluster.driver.epoch == epoch_at_quorum, \
+            "driver advanced the epoch below min_np quorum"
+        assert cluster.driver._reshard_pending is None, \
+            "a reshard was armed for a sub-quorum world"
+        assert not cluster.driver.finished()
+    finally:
+        cluster.stop()
