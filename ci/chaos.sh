@@ -68,6 +68,47 @@ EOF
 cat ci/chaos.largemesh.log
 [ "$rc" -eq 0 ] || { echo "large-mesh lane FAILED (rc=$rc)"; exit "$rc"; }
 
+# Negotiation fan-in lane (docs/data_plane.md "Negotiation fan-in"): a
+# bounded np=1024 sim — the REAL coordinator mask path behind a scripted
+# mesh, star vs tree over the arithmetic wire clock — must show the
+# O(ranks)->O(hosts) ingress drop counter-asserted, bit-identical agreed
+# masks, and >= 0.90 critical-path coverage, with the lock-dependency
+# tracker armed and ZERO inversion cycles.  The np=4096 curve artifact
+# regenerates in the slow-marked test below.
+echo "negotiation lane: np=1024 sim fan-in under HOROVOD_LOCK_DEBUG=1"
+rc=0
+JAX_PLATFORMS=cpu HOROVOD_LOCK_DEBUG=1 HOROVOD_SIM_SEED=0 \
+python - > ci/chaos.negotiation.log 2>&1 <<'EOF' || rc=$?
+from horovod_tpu.common import lockdep
+from horovod_tpu.sim.negotiation import SimNegotiation
+
+rec = SimNegotiation(1024, slots_per_host=8, seed=0).run(cycles=4)
+assert rec["star"]["ingress_frames_per_cycle"] == 1023, rec
+assert rec["fanin"]["ingress_frames_per_cycle"] == 127 + 7, rec
+assert rec["star"]["reply_mask"] == rec["fanin"]["reply_mask"] != 0, rec
+for mode in ("star", "fanin"):
+    assert rec["attribution"][mode]["coverage"] >= 0.90, rec["attribution"]
+cycles = lockdep.find_cycles()
+assert not cycles, f"lock inversion cycles: {cycles}"
+print(f"np=1024 negotiation: ingress {rec['star']['ingress_frames_per_cycle']}"
+      f" -> {rec['fanin']['ingress_frames_per_cycle']} frames/cycle, "
+      f"cycle speedup {rec['cycle_speedup_p50']}x, "
+      f"coverage {rec['attribution']['fanin']['coverage']:.2%}, 0 lock cycles")
+EOF
+cat ci/chaos.negotiation.log
+[ "$rc" -eq 0 ] || { echo "negotiation lane FAILED (rc=$rc)"; exit "$rc"; }
+
+# The np=4096 committed-artifact proof (star-vs-tree latency curves,
+# benchmarks/results/sim_negotiation_np4096.json): slow-marked, so
+# tier-1 never pays for it; this lane regrows and re-verifies it.
+echo "negotiation artifact lane: np=4096 curve regeneration"
+rc=0
+JAX_PLATFORMS=cpu HOROVOD_LOCK_DEBUG=1 \
+python -m pytest "tests/test_sim_cluster.py::test_sim_negotiation_np4096_artifact" \
+    -m slow -v -p no:cacheprovider > ci/chaos.negotiation_artifact.log 2>&1 || rc=$?
+cat ci/chaos.negotiation_artifact.log
+[ "$rc" -eq 0 ] || { echo "negotiation artifact lane FAILED (rc=$rc)"; exit "$rc"; }
+
 # Self-healing demotion lane (docs/elastic.md "Self-healing demotion").
 # The live np=3 chronic-straggler scenario (host shed, cause=demotion,
 # bit-identical convergence, HOROVOD_LOCK_DEBUG=1 below) already ran in

@@ -79,6 +79,32 @@ HOROVOD_FANIN = "HOROVOD_FANIN"
 # Base directory for the fan-in spool (per-host, must be shared by the
 # host's ranks and is probed writable); default /dev/shm.
 HOROVOD_FANIN_DIR = "HOROVOD_FANIN_DIR"
+# -- negotiation fan-in (data plane; docs/data_plane.md "Negotiation
+#    fan-in") --
+# Tree-structured negotiation fan-in ("1"/"0"/"auto", default auto = on
+# when the layout is blocked-homogeneous with >= 2 ranks/host on >= 2
+# hosts): each host's local_rank-0 rank ANDs its host's mask frames into
+# ONE HostMaskFrame forwarded to the coordinator, so coordinator ingress
+# per busy cycle scales with HOSTS, not ranks.  "1" forces it on (a
+# non-blocked rank layout is then a loud config error); supersedes
+# HOROVOD_CONTROLLER_TOPOLOGY while active.
+HOROVOD_NEGOTIATION_FANIN = "HOROVOD_NEGOTIATION_FANIN"
+# Negotiation-aggregator heartbeat period (seconds).  The aggregator
+# touches its heartbeat file once per period while cycles complete;
+# members convict a WEDGED (alive-but-stuck) aggregator when the file
+# goes ~1.5 periods stale (elastic/fanin.py's staleness constant) and
+# raise AggregatorStaleError — coordinated abort + veto, so the next
+# epoch runs the host direct.  Aggregator DEATH needs no heartbeat: the
+# member's blocking recv raises PeerGoneError promptly.
+HOROVOD_NEGOTIATION_FANIN_HEARTBEAT_SECS = \
+    "HOROVOD_NEGOTIATION_FANIN_HEARTBEAT_SECS"
+# Epochs a stale-aggregator veto keeps its host on the direct path
+# before the host may re-tree (conviction hysteresis; >= 1).
+HOROVOD_NEGOTIATION_FANIN_VETO_EPOCHS = \
+    "HOROVOD_NEGOTIATION_FANIN_VETO_EPOCHS"
+# Base directory for the per-host negotiation heartbeat file (must be
+# shared by the host's ranks); default: the system temp dir.
+HOROVOD_NEGOTIATION_FANIN_DIR = "HOROVOD_NEGOTIATION_FANIN_DIR"
 # -- simulated-cluster harness (horovod_tpu/sim/; docs/sim_cluster.md) --
 # Shaped-wire injection for sim runs: deterministic per-link base latency
 # (ms), uniform jitter bound (ms), and bandwidth (MB/s) applied around
@@ -288,6 +314,14 @@ HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
 HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+# Fold the wire-compression codec ({none, fp16, bf16, int8, onebit})
+# into the autotuner's search space as a categorical dimension ("1"/"0",
+# default off): codec verdicts are gated by the A/B sign test
+# (benchmarks/ab_harness.py idiom) before a switch is recommended, and
+# the tuned codec is only ever REPORTED (autotune log) — the live wire
+# format still follows HOROVOD_WIRE_COMPRESSION, which all ranks must
+# agree on.
+HOROVOD_AUTOTUNE_CODEC = "HOROVOD_AUTOTUNE_CODEC"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_LOG_HIDE_TIMESTAMP = "HOROVOD_LOG_HIDE_TIMESTAMP"
 HOROVOD_ADASUM_MPI_CHUNK_SIZE = "HOROVOD_ADASUM_MPI_CHUNK_SIZE"
@@ -381,6 +415,16 @@ DEFAULT_RENDEZVOUS_BATCH_MAX_OPS = 512
 DEFAULT_SIM_LATENCY_MS = 0.2
 DEFAULT_SIM_JITTER_MS = 0.05
 DEFAULT_SIM_BANDWIDTH_MBS = 1000.0
+# 1 s heartbeat: conviction of a wedged negotiation aggregator lands in
+# ~1.5 s — far under the stall-warning plane (60 s) that otherwise owns
+# stuck negotiations, while the once-per-period utime stays noise next
+# to ~1 ms negotiation cycles.
+DEFAULT_NEGOTIATION_FANIN_HEARTBEAT_SECS = 1.0
+# 2 epochs of direct traffic after a stale-aggregator conviction: one
+# epoch would re-tree immediately after the very reshard the conviction
+# caused; two keeps a flapping host from oscillating tree/direct every
+# recovery.
+DEFAULT_NEGOTIATION_FANIN_VETO_EPOCHS = 2
 
 
 def get_int(name: str, default: int) -> int:

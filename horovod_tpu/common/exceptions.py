@@ -76,6 +76,30 @@ class CoordinatedAbortError(HorovodInternalError):
         self.reason = reason
 
 
+class AggregatorStaleError(HorovodInternalError):
+    """A negotiation-fan-in member convicted its host's aggregator as
+    wedged: the aggregator's heartbeat file went stale (older than ~1.5
+    heartbeat periods) while the member was about to hand it this cycle's
+    mask frame (``core/negotiation_fanin.py``).
+
+    Deliberately a ``HorovodInternalError``: the member cannot reroute
+    mid-epoch (the lockstep mesh recv set is fixed at epoch start), so
+    conviction means coordinated abort + cheap in-place reshard — and
+    ``core/state.py`` writes a veto to the rendezvous store first, so the
+    recovered epoch runs the convicted host on the DIRECT path instead of
+    re-treeing under the same wedged aggregator."""
+
+    def __init__(self, aggregator_rank: int, cross_rank: int, age: float,
+                 window: float):
+        super().__init__(
+            f"negotiation aggregator rank {aggregator_rank} (host "
+            f"{cross_rank}) heartbeat is {age:.2f}s stale "
+            f"(window {window:.2f}s); degrading this host to direct "
+            "mask pushes via coordinated abort + reshard")
+        self.aggregator_rank = aggregator_rank
+        self.cross_rank = cross_rank
+
+
 class FaultInjectedError(HorovodInternalError):
     """Raised by ``common/faults.py`` for ``action=raise`` — rides every
     path a real collective failure does (elastic rollback included)."""

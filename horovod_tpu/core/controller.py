@@ -44,6 +44,7 @@ from ..transport.tcp import TcpMesh
 from . import metrics
 from .messages import (
     DataType,
+    HostMaskFrame,
     MaskFrame,
     Request,
     RequestList,
@@ -51,6 +52,7 @@ from .messages import (
     Response,
     ResponseList,
     ResponseType,
+    is_host_mask_frame,
     is_mask_frame,
 )
 
@@ -313,6 +315,28 @@ class Controller:
         # _mature_deferred_tallies once mature — the injected slowness
         # lands on one rank's tallies while the cycle keeps turning.
         self._deferred_tallies: List[Tuple[float, Request]] = []
+        # Tree negotiation fan-in (core/negotiation_fanin.py): installed
+        # per epoch by state._sync_controller_topology via
+        # configure_fanin; while a plan is active it supersedes
+        # fanout_topology — the wire shape is plan-defined end to end.
+        self.fanin_plan = None
+        self.fanin_heartbeat = None
+        # Fast-path counters (exposed through state's controller metrics
+        # view, like the cycle counters above — the ~1 ms negotiation
+        # hot path never touches the metrics registry): coordinator
+        # ingress frames/bytes per gather (every fan-out shape counts
+        # them, so star-vs-fanin comparisons read the same series), the
+        # per-rank upward-frame split by path, and stale-aggregator
+        # convictions.
+        self.ingress_frame_count = 0
+        self.ingress_byte_count = 0
+        self.fanin_tree_frame_count = 0
+        self.fanin_direct_frame_count = 0
+        self.fanin_fallback_count = 0
+        # Lockstep cycle index: every rank increments once per
+        # compute_response_list, so it is consistent across ranks without
+        # a wire field — the FANIN_RELAY span's cycle tag rides it.
+        self.cycle_index = 0
 
     # ------------------------------------------------------------------
     # the per-cycle negotiation round
@@ -322,6 +346,7 @@ class Controller:
                               should_shutdown: bool = False) -> ResponseList:
         """One synchronous negotiation round. All ranks must call this every
         cycle; the TCP recv provides the lockstep."""
+        self.cycle_index += 1
         if faults.ACTIVE:
             faults.inject("controller.negotiate", rank=self.topo.rank)
         if self.topo.size == 1:
@@ -381,13 +406,91 @@ class Controller:
                                                     frame.shutdown)
         return self._apply_response_list(ResponseList.from_bytes(payload))
 
+    def configure_fanin(self, plan, heartbeat=None) -> None:
+        """Install (plan != None) or clear this epoch's negotiation
+        fan-in plan (``core/negotiation_fanin.py:FaninPlan``).  Called at
+        epoch bring-up, after every rank adopted rank 0's decision
+        (``state._sync_controller_topology``) — mid-epoch installs would
+        desynchronize the lockstep recv sets.  An active plan supersedes
+        ``fanout_topology``: gather, broadcast, and worker rounds all
+        follow the plan's roles."""
+        self.fanin_plan = plan
+        self.fanin_heartbeat = heartbeat
+        if plan is not None:
+            log.debug("negotiation fan-in active: rank %d role=%s "
+                      "aggregator=%d members=%s",
+                      self.topo.rank, plan.role, plan.aggregator_rank,
+                      list(plan.member_ranks))
+
     def _worker_round(self, requests: List[Request],
                       should_shutdown: bool) -> ResponseList:
         payload = self._worker_payload(requests, should_shutdown)
+        plan = self.fanin_plan
+        if plan is not None:
+            if plan.role == "member":
+                return self._worker_round_member(payload)
+            if plan.role == "aggregator":
+                return self._worker_round_aggregator(payload)
+            # "direct": host 0 or a vetoed host — star semantics, but
+            # counted so the tree-vs-direct split is observable.
+            self.fanin_direct_frame_count += 1
+            self.mesh.send(0, payload)
+            return self._apply_reply(self.mesh.recv(0))
         if self.fanout_topology == "tree":
             return self._worker_round_tree(payload)
         self.mesh.send(0, payload)
         return self._apply_reply(self.mesh.recv(0))
+
+    def _worker_round_member(self, payload: bytes) -> ResponseList:
+        """Fan-in member: heartbeat-gate, then route this cycle through
+        the host's aggregator.  A stale heartbeat raises
+        AggregatorStaleError BEFORE the send — the member must not park
+        a frame with (and then recv-block on) an aggregator it has
+        already convicted.  Aggregator DEATH needs no gate: the blocking
+        recv raises PeerGoneError promptly and the coordinated abort +
+        reshard recovery owns it."""
+        hb = self.fanin_heartbeat
+        if hb is not None:
+            from ..common.exceptions import AggregatorStaleError
+
+            try:
+                hb.check()
+            except AggregatorStaleError:
+                self.fanin_fallback_count += 1
+                raise
+        self.fanin_tree_frame_count += 1
+        agg = self.fanin_plan.aggregator_rank
+        self.mesh.send(agg, payload)
+        return self._apply_reply(self.mesh.recv(agg))
+
+    def _worker_round_aggregator(self, payload: bytes) -> ResponseList:
+        """Fan-in aggregator: collect the host's cycle payloads, fold the
+        mask frames into one HostMaskFrame (fold_host — stateless, pure
+        per cycle), forward ONE bundle to the coordinator, and relay the
+        response payload down verbatim (it is identical for every rank,
+        like the tree fan-out's relays).  Heartbeat is touched AFTER the
+        relay completes: a wedged coordinator link must not keep
+        advertising a live aggregator while members' frames pile up."""
+        from . import timeline as timeline_mod
+        from .negotiation_fanin import fold_host
+
+        t0 = time.monotonic_ns() if timeline_mod.control_active() else None
+        collected = [(self.topo.rank, payload)]
+        for member in self.fanin_plan.member_ranks:
+            collected.append((member, self.mesh.recv(member)))
+        self.mesh.send(0, _encode_bundle(fold_host(collected)))
+        self.fanin_tree_frame_count += 1
+        reply = self.mesh.recv(0)
+        for member in self.fanin_plan.member_ranks:
+            self.mesh.send(member, reply)
+        hb = self.fanin_heartbeat
+        if hb is not None:
+            hb.touch()
+        if t0 is not None:
+            timeline_mod.control_span_since(
+                "controller", "FANIN_RELAY", t0, cycle=self.cycle_index,
+                members=len(self.fanin_plan.member_ranks))
+        return self._apply_reply(reply)
 
     def _worker_round_tree(self, payload: bytes) -> ResponseList:
         """Binomial-tree flavor: relay the subtree's gather bundles up to
@@ -416,14 +519,50 @@ class Controller:
         self.serialized_request_count += len(rl.requests)
         return rl, False
 
+    def _recv_ingress(self, sender: int) -> bytes:
+        """One coordinator gather recv, counted: every fan-out shape
+        funnels through here so ``controller_ingress_frames_total`` /
+        ``_bytes_total`` compare star vs tree vs fan-in like for like —
+        one increment per frame that actually arrived at rank 0."""
+        data = self.mesh.recv(sender)
+        self.ingress_frame_count += 1
+        self.ingress_byte_count += len(data)
+        return data
+
     def _gather_request_lists(self):
         """Yield every other rank's (rank, RequestList, was_mask) for this
-        cycle, in deterministic rank order for the tree (the star's serial
-        loop is ordered by construction)."""
-        if self.fanout_topology == "tree":
+        cycle, in deterministic rank order for the tree and fan-in shapes
+        (the star's serial loop is ordered by construction).
+
+        Under fan-in, a HostMaskFrame expands to one identical
+        pending-mask contribution per covered rank — bit-exact with the
+        star's per-rank MaskFrames because the frame's mask is the AND of
+        exactly those ranks' masks and every rank re-announces its full
+        mask every cycle."""
+        plan = self.fanin_plan
+        if plan is not None and plan.role == "coordinator":
             entries: List[tuple] = []
+            for sender in plan.coordinator_senders:
+                data = self._recv_ingress(sender)
+                if sender in plan.bundle_senders:
+                    entries.extend(_decode_bundle(data))
+                else:
+                    entries.append((sender, data))
+            entries.sort()
+            for rank, payload in entries:
+                if is_host_mask_frame(payload):
+                    frame = HostMaskFrame.from_bytes(payload)
+                    for covered in frame.covered:
+                        yield covered, RequestList(
+                            shutdown=frame.shutdown,
+                            cache_mask=frame.mask), True
+                else:
+                    rl, was_mask = self._decode_worker_payload(payload)
+                    yield rank, rl, was_mask
+        elif self.fanout_topology == "tree":
+            entries = []
             for child in tree_children(0, self.topo.size):
-                entries.extend(_decode_bundle(self.mesh.recv(child)))
+                entries.extend(_decode_bundle(self._recv_ingress(child)))
             entries.sort()
             for rank, payload in entries:
                 rl, was_mask = self._decode_worker_payload(payload)
@@ -431,11 +570,15 @@ class Controller:
         else:
             for worker in range(1, self.topo.size):
                 rl, was_mask = self._decode_worker_payload(
-                    self.mesh.recv(worker))
+                    self._recv_ingress(worker))
                 yield worker, rl, was_mask
 
     def _broadcast_response_payload(self, payload: bytes) -> None:
-        if self.fanout_topology == "tree":
+        plan = self.fanin_plan
+        if plan is not None and plan.role == "coordinator":
+            for sender in plan.coordinator_senders:
+                self.mesh.send(sender, payload)
+        elif self.fanout_topology == "tree":
             for child in tree_children(0, self.topo.size):
                 self.mesh.send(child, payload)
         else:

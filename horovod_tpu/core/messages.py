@@ -26,6 +26,7 @@ from ..common.exceptions import TruncatedFrameError
 
 WIRE_MAGIC = 0x48564454  # "HVDT"
 MASK_MAGIC = 0x4B53414D  # "MASK" — steady-state fast-path frame
+HOST_MASK_MAGIC = 0x4B534D48  # "HMSK" — fan-in aggregated mask frame
 ABORT_MAGIC = 0x54524241  # "ABRT" — coordinated-abort control frame
 
 #: AbortFrame.reason budget (bytes, UTF-8): an abort carrying a giant
@@ -385,6 +386,59 @@ def is_mask_frame(data: bytes) -> bool:
     """True when ``data`` is a MaskFrame (vs RequestList/ResponseList)."""
     return len(data) >= 4 and \
         struct.unpack_from("<I", data)[0] == MASK_MAGIC
+
+
+@dataclass
+class HostMaskFrame:
+    """One HOST's aggregated steady-state contribution — the negotiation
+    fan-in frame (``core/negotiation_fanin.py``).
+
+    Under tree fan-in the host's aggregator ANDs the MaskFrames of the
+    colocated ranks it covers into one bitvector and forwards THIS frame
+    in their place, so coordinator ingress per busy cycle scales with
+    hosts, not ranks.  Correctness leans on the mask fast path's
+    re-announcement property: every rank re-announces its FULL pending
+    cache-bit mask every cycle, so the aggregation is a stateless
+    per-cycle fold — nothing is accumulated at the aggregator, and an
+    aggregator death can lose at most the in-flight cycle, which the
+    lockstep abort already discards on every path.  ``covered`` names the
+    exact ranks whose masks were folded (ranks that sent a full
+    RequestList ride the bundle unfolded); the coordinator expands the
+    frame to one identical pending-mask contribution per covered rank.
+    ``shutdown`` is the OR of the covered ranks' flags, matching the
+    coordinator's own OR-fold over per-rank frames.
+    """
+
+    covered: List[int] = field(default_factory=list)
+    mask: bytes = b""        # little-endian big-int bitvector (AND-fold)
+    shutdown: bool = False
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        w.u32(HOST_MASK_MAGIC)
+        w.u8(1 if self.shutdown else 0)
+        w.i32_list(self.covered)
+        w.u32(len(self.mask))
+        w.buf += self.mask
+        return w.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "HostMaskFrame":
+        r = Reader(data)
+        r.expect_magic(HOST_MASK_MAGIC, "host-mask-frame")
+        shutdown = bool(r.u8())
+        covered = r.i32_list()
+        return HostMaskFrame(covered=covered, mask=r.bytes_(r.u32()),
+                             shutdown=shutdown)
+
+    @property
+    def mask_int(self) -> int:
+        return int.from_bytes(self.mask, "little")
+
+
+def is_host_mask_frame(data: bytes) -> bool:
+    return len(data) >= 4 and \
+        struct.unpack_from("<I", data)[0] == HOST_MASK_MAGIC
 
 
 @dataclass

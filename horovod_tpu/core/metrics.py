@@ -78,6 +78,21 @@ CATALOG: Dict[str, Tuple[str, str]] = {
         "gauge", "(fast + idle-fast cycles) / all cycles since init"),
     "controller_cycle_seconds": (
         "histogram", "busy negotiation-round duration (idle parks excluded)"),
+    "negotiation_fanin_frames_total": (
+        "counter", "readiness frames this rank pushed toward the "
+                   "coordinator, labeled path=tree (via/as the host "
+                   "aggregator) or path=direct (straight to rank 0)"),
+    "negotiation_fanin_fallbacks_total": (
+        "counter", "stale-aggregator convictions on this rank — each one "
+                   "is a coordinated abort + reshard that degrades the "
+                   "host to the direct path for the veto cooldown"),
+    "controller_ingress_frames_total": (
+        "counter", "negotiation frames rank 0 received per-sender (tree "
+                   "bundles count once; O(hosts) under fan-in vs "
+                   "O(ranks) star — nonzero on the coordinator only)"),
+    "controller_ingress_bytes_total": (
+        "counter", "payload bytes behind controller_ingress_frames_total "
+                   "(nonzero on the coordinator only)"),
     "tensor_queue_depth": (
         "gauge", "tensors in flight (submitted, not yet completed)"),
     # -- collectives --

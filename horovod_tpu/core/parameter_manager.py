@@ -27,6 +27,89 @@ import numpy as np
 _FUSION_MB_RANGE = (0.0, 64.0)
 _CYCLE_MS_RANGE = (1.0, 25.0)
 
+# Categorical wire-codec dimension (HOROVOD_AUTOTUNE_CODEC): the codecs
+# the compression subsystem speaks (transport/compression.py), with
+# "none" as the paired-comparison baseline.  The codec is tuned by
+# sign-tested A/B pairs, not by the GP — a categorical knob has no
+# gradient for expected improvement to climb, and the reference tunes
+# its categorical knobs (hierarchical ops, cache) by category grids for
+# the same reason.
+_CODECS = ("none", "fp16", "bf16", "int8", "onebit")
+_CODEC_ALPHA = 0.05
+
+
+def _sign_test_p(wins: int, losses: int) -> float:
+    """Two-sided paired sign-test p-value, numerically identical to
+    ``benchmarks.ab_harness.sign_test_p`` (the PR-10 A/B gate) — kept
+    local so the core package never imports the benchmark harness."""
+    n = wins + losses
+    if n == 0:
+        return 1.0
+    k = min(wins, losses)
+    tail = sum(math.comb(n, i) for i in range(k + 1)) / 2.0 ** n
+    return min(1.0, 2.0 * tail)
+
+
+class CodecArm:
+    """Paired A/B exploration of the categorical codec dimension.
+
+    Samples alternate baseline/candidate: each even observation runs the
+    baseline codec, each odd one the current candidate, and the two
+    scores form one sign-test pair (ties discarded, like the harness).
+    Candidates rotate round-robin so every codec keeps accruing pairs
+    for as long as the tuner runs.  A candidate is recommended only on a
+    significant win — strictly more wins than losses AND a two-sided
+    sign-test p below alpha — otherwise the recommendation stays
+    "none".  The verdict is report-only: the live wire format follows
+    HOROVOD_WIRE_COMPRESSION, which all ranks must agree on, so the
+    coordinator never flips it unilaterally mid-run.
+    """
+
+    def __init__(self, codecs: Tuple[str, ...] = _CODECS,
+                 alpha: float = _CODEC_ALPHA):
+        if len(codecs) < 2:
+            raise ValueError("need a baseline plus >= 1 candidate codec")
+        self.codecs = tuple(codecs)
+        self.alpha = alpha
+        self._candidates = self.codecs[1:]
+        self._idx = 0                     # candidate being paired
+        self._baseline_score: Optional[float] = None
+        self._wins = {c: 0 for c in self._candidates}
+        self._losses = {c: 0 for c in self._candidates}
+
+    @property
+    def under_test(self) -> str:
+        """Codec the in-flight sample is (notionally) measured under."""
+        if self._baseline_score is None:
+            return self.codecs[0]
+        return self._candidates[self._idx]
+
+    def observe(self, score: float) -> None:
+        if self._baseline_score is None:
+            self._baseline_score = score
+            return
+        cand = self._candidates[self._idx]
+        if score > self._baseline_score:
+            self._wins[cand] += 1
+        elif score < self._baseline_score:
+            self._losses[cand] += 1
+        self._baseline_score = None
+        self._idx = (self._idx + 1) % len(self._candidates)
+
+    def recommendation(self) -> Tuple[str, float]:
+        """(codec, p-value) — baseline with p=1.0 unless some candidate
+        clears the sign-test gate; the lowest-p significant winner
+        breaks ties."""
+        best, best_p = self.codecs[0], 1.0
+        for cand in self._candidates:
+            wins, losses = self._wins[cand], self._losses[cand]
+            if wins <= losses:
+                continue
+            p = _sign_test_p(wins, losses)
+            if p < self.alpha and p < best_p:
+                best, best_p = cand, p
+        return best, best_p
+
 
 class GaussianProcess:
     """RBF-kernel GP regression (reference ``optim/gaussian_process.cc``)."""
@@ -116,7 +199,9 @@ class ParameterManager:
                  steps_per_sample: int = 10, max_samples: int = 20,
                  initial_fusion_bytes: int = 64 * 1024 * 1024,
                  initial_cycle_ms: float = 1.0,
-                 log_path: Optional[str] = None, seed: int = 0):
+                 log_path: Optional[str] = None, seed: int = 0,
+                 tune_codec: bool = False,
+                 codec_alpha: float = _CODEC_ALPHA):
         self.enabled = enabled
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -133,17 +218,23 @@ class ParameterManager:
         self._best: Tuple[float, Tuple[int, float]] = (
             -1.0, (initial_fusion_bytes, initial_cycle_ms))
         self._done = False
+        # Categorical codec dimension (HOROVOD_AUTOTUNE_CODEC, default
+        # off): A/B sign-test pairs over _CODECS, report-only (see
+        # CodecArm).  The reference's other categorical knobs
+        # (hierarchical ops, cache on/off) stay structural here.
+        self._codec_arm = CodecArm(alpha=codec_alpha) if tune_codec else None
         # Per-sample CSV artifact (reference HOROVOD_AUTOTUNE_LOG,
         # ``parameter_manager.h:112`` / ``.cc:81,266-272``): header naming
         # the tunables, one row per sample, and a final ``best`` row when
-        # the tuner settles.  Our tunable set is (cycle_time_ms,
-        # fusion_threshold_mb) — the reference's categorical knobs
-        # (hierarchical ops, cache on/off) are structural here, not tuned.
+        # the tuner settles.  The codec column appears only when the
+        # codec arm is on, so the established 4-column schema is stable
+        # for every existing consumer.
         self._log = open(log_path, "w") if log_path else None
         if self._log:
             self._log.write(
                 "sample,cycle_time_ms,tensor_fusion_threshold_mb,"
-                "score_bytes_per_sec\n")
+                "score_bytes_per_sec"
+                + (",codec" if self._codec_arm else "") + "\n")
             self._log.flush()
 
     @property
@@ -153,6 +244,21 @@ class ParameterManager:
     @property
     def cycle_time_ms(self) -> float:
         return self._cycle_ms
+
+    @property
+    def codec_under_test(self) -> str:
+        """Codec the in-flight sample is attributed to ("none" unless
+        the codec arm is on)."""
+        return self._codec_arm.under_test if self._codec_arm else _CODECS[0]
+
+    @property
+    def recommended_codec(self) -> str:
+        """Sign-test-gated codec verdict so far: a candidate only when
+        its paired wins over "none" are significant at the arm's alpha.
+        Report-only — the wire format stays HOROVOD_WIRE_COMPRESSION."""
+        if self._codec_arm is None:
+            return _CODECS[0]
+        return self._codec_arm.recommendation()[0]
 
     def update(self, nbytes: int) -> Optional[Tuple[int, float]]:
         """Record one negotiation cycle's reduced byte volume; returns new
@@ -195,12 +301,18 @@ class ParameterManager:
         self._sample_start = now
         params = (self._fusion_bytes / (1024.0 * 1024.0), self._cycle_ms)
         self._samples_seen += 1
+        # Attribute the closing sample to its codec BEFORE the arm
+        # observes it (observing flips the baseline/candidate phase).
+        codec = self._codec_arm.under_test if self._codec_arm else None
         if self._log:
             self._log.write(f"{self._samples_seen},{params[1]:.2f},"
-                            f"{params[0]:.2f},{score:.0f}\n")
+                            f"{params[0]:.2f},{score:.0f}"
+                            + (f",{codec}" if codec else "") + "\n")
             self._log.flush()
         if self._samples_seen > self.warmup_samples:
             self._bo.observe(params, score)
+            if self._codec_arm:
+                self._codec_arm.observe(score)
             if score > self._best[0]:
                 self._best = (score, (self._fusion_bytes, self._cycle_ms))
 
@@ -213,7 +325,9 @@ class ParameterManager:
                 self._log.write(
                     f"best,{self._cycle_ms:.2f},"
                     f"{self._fusion_bytes / (1024.0 * 1024.0):.2f},"
-                    f"{max(self._best[0], 0):.0f}\n")
+                    f"{max(self._best[0], 0):.0f}"
+                    + (f",{self.recommended_codec}"
+                       if self._codec_arm else "") + "\n")
                 self._log.close()
                 self._log = None
         else:

@@ -187,7 +187,8 @@ class HorovodGlobalState:
                     env_mod.HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),
                 initial_fusion_bytes=fusion,
                 initial_cycle_ms=self.cycle_time_ms,
-                log_path=env_mod.get_str(env_mod.HOROVOD_AUTOTUNE_LOG) or None)
+                log_path=env_mod.get_str(env_mod.HOROVOD_AUTOTUNE_LOG) or None,
+                tune_codec=env_mod.get_bool(env_mod.HOROVOD_AUTOTUNE_CODEC))
         self.controller = Controller(
             topo, self.mesh,
             fusion_threshold_bytes=fusion,
@@ -197,6 +198,10 @@ class HorovodGlobalState:
             cache_capacity=env_mod.get_int(env_mod.HOROVOD_CACHE_CAPACITY,
                                            env_mod.DEFAULT_CACHE_CAPACITY),
             parameter_manager=self.parameter_manager)
+        # Resolved store (caller-provided OR the HTTP fallback built
+        # above) kept for teardown-path writes: the stale-aggregator
+        # veto must land BEFORE the abort broadcast tears the job down.
+        self._active_store = store
         if store is not None:
             self._sync_controller_topology(store, epoch, startup_timeout)
         timeline_path = env_mod.get_str(env_mod.HOROVOD_TIMELINE)
@@ -238,27 +243,148 @@ class HorovodGlobalState:
         star-vs-tree mismatch deadlocks the first negotiation round with no
         diagnostic (each side recv-blocks on a peer that will never send).
         Making rank 0's choice authoritative-and-checked turns that silent
-        hang into a loud bring-up error naming the env fix."""
+        hang into a loud bring-up error naming the env fix.
+
+        The negotiation fan-in decision (docs/data_plane.md "Negotiation
+        fan-in") rides the same scope: rank 0 resolves the mode, folds in
+        any still-cooling stale-aggregator vetoes, and publishes
+        ``{"mode": ..., "vetoed": [host indices]}``; workers ADOPT the
+        record (no per-rank comparison — the record plus the shared
+        topology numbers determine every role arithmetically), then each
+        rank installs its FaninPlan before the first cycle.  Mid-epoch
+        installs are impossible by construction: the lockstep recv sets
+        must agree from cycle one."""
+        import json
+
+        from . import negotiation_fanin as fanin_mod
+
         scope = f"controller.{epoch}"
         chosen = self.controller.fanout_topology
         if self.topo.rank == 0:
-            store.set(scope, "topology", chosen.encode())
-            return
+            mode = fanin_mod.resolve_mode(self.topo)
+            vetoed = self._read_fanin_vetoes(store, epoch) \
+                if mode == "on" else []
+            decision = {"mode": mode, "vetoed": vetoed}
+            store.batch([
+                ("set", scope, "topology", chosen.encode()),
+                ("set", scope, "fanin", json.dumps(decision).encode()),
+            ])
+        else:
+            try:
+                got = store.wait(scope, ["topology", "fanin"],
+                                 timeout=timeout)
+                agreed = got["topology"].decode()
+                decision = json.loads(got["fanin"].decode())
+            except Exception as e:  # noqa: BLE001
+                raise HorovodInternalError(
+                    f"rank {self.topo.rank} could not read rank 0's "
+                    f"controller topology/fan-in decision from the "
+                    f"rendezvous store: {e}") from e
+            if agreed != chosen:
+                raise HorovodInternalError(
+                    f"controller topology mismatch: rank 0 negotiates over "
+                    f"{agreed!r} but rank {self.topo.rank} derived "
+                    f"{chosen!r} from its environment — "
+                    f"HOROVOD_CONTROLLER_TOPOLOGY (or world size) differs "
+                    f"across ranks; propagate the same value to every host "
+                    f"(a star/tree mismatch would deadlock the first "
+                    f"negotiation round)")
+        self._configure_negotiation_fanin(decision, store)
+
+    def _read_fanin_vetoes(self, store, epoch: int) -> List[int]:
+        """Cross-rank indices of hosts under an active stale-aggregator
+        veto (rank 0 only).  Best-effort end to end — a veto is an
+        optimization hint (keep a convicted host off the tree), never a
+        correctness dependency, so store trouble or an unresolvable
+        hostname silently yields no veto."""
+        import json
+
+        from ..transport.scopes import (
+            NEGOTIATION_VETO_SCOPE,
+            RANK_AND_SIZE_SCOPE,
+        )
+        from .negotiation_fanin import active_vetoes
+
         try:
-            agreed = store.wait(scope, ["topology"],
-                                timeout=timeout)["topology"].decode()
-        except Exception as e:  # noqa: BLE001
-            raise HorovodInternalError(
-                f"rank {self.topo.rank} could not read rank 0's controller "
-                f"topology from the rendezvous store: {e}") from e
-        if agreed != chosen:
-            raise HorovodInternalError(
-                f"controller topology mismatch: rank 0 negotiates over "
-                f"{agreed!r} but rank {self.topo.rank} derived {chosen!r} "
-                f"from its environment — HOROVOD_CONTROLLER_TOPOLOGY (or "
-                f"world size) differs across ranks; propagate the same "
-                f"value to every host (a star/tree mismatch would deadlock "
-                f"the first negotiation round)")
+            names = store.keys(NEGOTIATION_VETO_SCOPE)
+            if not names:
+                return []
+            records = {}
+            for name in names:
+                raw = store.get(NEGOTIATION_VETO_SCOPE, name)
+                if raw is not None:
+                    records[name] = json.loads(bytes(raw).decode())
+            hostnames = active_vetoes(records, epoch)
+            if not hostnames:
+                return []
+            # hostname → host index via the driver's slot table
+            # (identities are ``hostname:local_rank`` keys).
+            vetoed = set()
+            for key in store.keys(RANK_AND_SIZE_SCOPE):
+                hostname = key.rsplit(":", 1)[0]
+                if hostname not in hostnames:
+                    continue
+                raw = store.get(RANK_AND_SIZE_SCOPE, key)
+                if raw is None:
+                    continue
+                slot = json.loads(bytes(raw).decode())
+                if slot.get("epoch", 0) != epoch or slot.get("rank", -1) < 0:
+                    continue
+                vetoed.add(int(slot["rank"]) // self.topo.local_size)
+            if vetoed:
+                log.info("negotiation fan-in: hosts %s run DIRECT this "
+                         "epoch (stale-aggregator veto cooldown)",
+                         sorted(vetoed))
+            return sorted(vetoed)
+        except Exception as e:  # noqa: BLE001 — hint, not load-bearing
+            log.warning("negotiation fan-in veto read failed (%s); "
+                        "no hosts vetoed", e)
+            return []
+
+    def _configure_negotiation_fanin(self, decision, store) -> None:
+        from . import negotiation_fanin as fanin_mod
+
+        if not decision or decision.get("mode") != "on":
+            self.controller.configure_fanin(None)
+            return
+        plan = fanin_mod.build_plan(self.topo,
+                                    decision.get("vetoed") or ())
+        job_key = getattr(store, "_base", None) or "in-process"
+        heartbeat = fanin_mod.make_heartbeat(plan, self.topo, str(job_key))
+        self.controller.configure_fanin(plan, heartbeat)
+
+    def _write_fanin_veto(self, error: BaseException) -> None:
+        """Best-effort veto on the way down: a member that convicted its
+        aggregator as wedged (AggregatorStaleError) records the verdict
+        in the store BEFORE the abort broadcast, so the recovered epoch's
+        rank 0 keeps this host on the direct path for the cooldown
+        window.  Every failure here is swallowed — the abort must
+        proceed, and a lost veto only means the next epoch re-trees (and
+        re-convicts within ~1.5 heartbeat periods if still wedged)."""
+        from ..common.exceptions import AggregatorStaleError
+
+        if not isinstance(error, AggregatorStaleError):
+            return
+        store = getattr(self, "_active_store", None)
+        if store is None:
+            return
+        import json
+
+        from ..transport.scopes import NEGOTIATION_VETO_SCOPE
+
+        hostname = env_mod.get_str(env_mod.HOROVOD_HOSTNAME) \
+            or f"host-{self.topo.cross_rank}"
+        try:
+            store.set(NEGOTIATION_VETO_SCOPE, hostname, json.dumps({
+                "epoch": env_mod.get_epoch(),
+                "aggregator_rank": error.aggregator_rank,
+                "reason": str(error)[:300],
+            }).encode())
+            log.warning("negotiation fan-in veto posted for host %s "
+                        "(aggregator rank %d convicted as wedged)",
+                        hostname, error.aggregator_rank)
+        except Exception as e:  # noqa: BLE001 — teardown must proceed
+            log.warning("negotiation fan-in veto write failed: %s", e)
 
     def _controller_metrics_view(self) -> dict:
         """Metrics-registry view over the controller's fast-path counters
@@ -270,14 +396,27 @@ class HorovodGlobalState:
             return {}
         cycles = max(1, self.cycle_count)
         fast = c.fast_cycle_count + c.idle_fast_cycle_count
+        counters = {
+            "controller_cycles_total": self.cycle_count,
+            "controller_fast_cycles_total": c.fast_cycle_count,
+            "controller_idle_fast_cycles_total": c.idle_fast_cycle_count,
+            "controller_serialized_requests_total":
+                c.serialized_request_count,
+            # Negotiation fan-in instrumentation (plain controller ints,
+            # folded here so the per-cycle hot path never touches the
+            # registry).  Ingress counters exist on every rank but only
+            # the coordinator's move; exporting them everywhere keeps the
+            # view shape uniform for the aggregating scrape.
+            metrics.flat("negotiation_fanin_frames_total", path="tree"):
+                c.fanin_tree_frame_count,
+            metrics.flat("negotiation_fanin_frames_total", path="direct"):
+                c.fanin_direct_frame_count,
+            "negotiation_fanin_fallbacks_total": c.fanin_fallback_count,
+            "controller_ingress_frames_total": c.ingress_frame_count,
+            "controller_ingress_bytes_total": c.ingress_byte_count,
+        }
         return {
-            "counters": {
-                "controller_cycles_total": self.cycle_count,
-                "controller_fast_cycles_total": c.fast_cycle_count,
-                "controller_idle_fast_cycles_total": c.idle_fast_cycle_count,
-                "controller_serialized_requests_total":
-                    c.serialized_request_count,
-            },
+            "counters": counters,
             "gauges": {"controller_fast_cycle_ratio": fast / cycles},
         }
 
@@ -462,6 +601,7 @@ class HorovodGlobalState:
             # so the elastic run_fn retry loop picks it up identically.
             if self.async_error is None:
                 self.async_error = str(e)
+            self._write_fanin_veto(e)
             self._broadcast_abort(e)
             self._dump_flight_recorder(e)
             self._stop_dispatcher()

@@ -2,4 +2,5 @@
 workers (docs/sim_cluster.md)."""
 
 from .cluster import SimCluster, SimWorker  # noqa: F401
+from .negotiation import SimNegotiation  # noqa: F401
 from .wire import ShapedStore, ShapedWire  # noqa: F401

@@ -1,0 +1,401 @@
+"""Simulated negotiation plane: the REAL coordinator mask path at
+np=1024-4096, star vs tree fan-in, with an arithmetic wire clock.
+
+What is REAL here: rank 0's :class:`~horovod_tpu.core.controller.
+Controller` — ``compute_response_list`` runs the production
+``_coordinator_round`` end to end (gather, HostMaskFrame expansion,
+``_mask_round`` AND-fold, fast-path predicate, broadcast), plus
+``fold_host``/``_encode_bundle`` building each simulated host's bundle
+and ``build_plan`` deriving the roles.  What is SIMULATED: the other
+np-1 ranks — their steady-state contribution is a pure function (the
+full pending-bit MaskFrame, re-announced every cycle), so the sim
+fabricates the byte-identical frames a live worker would send — and the
+wire, which here is never slept on: per-link
+:class:`~horovod_tpu.sim.wire.ShapedWire` delays are ACCUMULATED into a
+simulated clock (``delay()`` returns seconds; only
+``ShapedStore._charge`` ever sleeps), so an np=4096 cycle that would
+take seconds of modeled serial ingress sims in microseconds of host
+time.
+
+The latency model is the serialization the topology actually imposes:
+
+- **star**: rank 0's gather loop ingests np-1 frames serially — the
+  cycle's negotiate time is the SUM of every worker link's delay, and
+  the dispatch time is the symmetric serial broadcast.  O(ranks).
+- **fan-in**: each host's members drain serially into their aggregator
+  (hosts fold concurrently, so that stage costs the MAX over hosts),
+  then rank 0 ingests (hosts-1) bundles plus (local_size-1) host-0
+  direct frames serially.  O(hosts) where it matters.
+
+Every run counter-asserts the ingress drop against the controller's own
+``ingress_frame_count`` (the metric the live job exports) and asserts
+the fan-in reply mask is bit-identical to the star reply mask — the
+PR 1 cache-bit semantics are the contract, the topology is only a wire
+shape.
+
+Each cycle also fabricates Chrome-trace spans on the simulated clock —
+``NEGOTIATE_MASK`` ingest windows with readiness instants,
+``FANIN_RELAY`` collect windows per aggregator (the dedicated ``fanin``
+phase), ``ALLREDUCE`` dispatch windows — and runs them through the REAL
+``hvd-critical-path`` analyzer, so the published artifact carries the
+same attribution document (coverage >= 0.90 enforced by the CI lane) a
+traced live run would.
+
+Determinism mirrors ``sim/cluster.py``: the digest is a SHA-256 over
+(seed, topology, frame sizes, every link's fresh-stream
+:meth:`~horovod_tpu.sim.wire.ShapedWire.preview`) — a pure function of
+the inputs, independent of host timing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..common import env as env_mod
+from ..common.topology import ProcessTopology
+from ..core.controller import Controller, _encode_bundle
+from ..core.messages import MaskFrame, Request, RequestList
+from ..core.negotiation_fanin import build_plan, fold_host
+from .wire import ShapedWire
+
+__all__ = ["SimNegotiation", "run_curve"]
+
+#: Modeled per-frame mesh framing overhead (length word + CRC trailer,
+#: transport/tcp.py) — keeps 3-byte mask frames from simming as free.
+FRAME_OVERHEAD_BYTES = 16
+
+#: Modeled coordinator compute per cycle (mask AND-fold + template
+#: rehydration), charged once per cycle in both shapes so the curves
+#: isolate the WIRE serialization difference.
+DISPATCH_COMPUTE_US = 150.0
+
+
+class _ScriptedMesh:
+    """Mesh stand-in for the coordinator: ``recv`` pops frames the sim
+    queued for a sender, ``send`` records the broadcast.  Any recv from
+    a sender the sim did not script is a hard error — the coordinator's
+    recv SET is part of what the sim verifies."""
+
+    def __init__(self):
+        self._inbox: Dict[int, List[bytes]] = {}
+        self.sent: List[Tuple[int, bytes]] = []
+
+    def queue(self, sender: int, data: bytes) -> None:
+        self._inbox.setdefault(sender, []).append(data)
+
+    def recv(self, sender: int) -> bytes:
+        frames = self._inbox.get(sender)
+        if not frames:
+            raise AssertionError(
+                f"coordinator recv from rank {sender}: nothing scripted "
+                "(gather recv set diverged from the sim's frame plan)")
+        return frames.pop(0)
+
+    def send(self, rank: int, data: bytes) -> None:
+        self.sent.append((rank, data))
+
+    def drain_sent(self) -> List[Tuple[int, bytes]]:
+        out, self.sent = self.sent, []
+        return out
+
+
+class SimNegotiation:
+    """One simulated negotiation plane at a fixed np."""
+
+    def __init__(self, np: int, slots_per_host: int = 8,
+                 tensors: int = 4, seed: Optional[int] = None):
+        if np % slots_per_host != 0:
+            raise ValueError("np must be a multiple of slots_per_host "
+                             "(blocked host-major layout)")
+        if seed is None:
+            seed = env_mod.get_int(env_mod.HOROVOD_SIM_SEED, 0)
+        self.np = np
+        self.slots_per_host = slots_per_host
+        self.hosts = np // slots_per_host
+        self.tensors = tensors
+        self.seed = seed
+        self.topo = ProcessTopology(
+            rank=0, size=np, local_rank=0, local_size=slots_per_host,
+            cross_rank=0, cross_size=self.hosts)
+        # One cross-host link per host (bundles / direct cross frames
+        # ride it) and one intra-host link per host (member -> aggregator
+        # drains; host 0's is also the coordinator's local ingress).
+        self._wires: Dict[str, ShapedWire] = {}
+
+    # -- wires ---------------------------------------------------------
+
+    def _wire(self, link: str) -> ShapedWire:
+        w = self._wires.get(link)
+        if w is None:
+            w = ShapedWire.from_env(link, seed=self.seed)
+            # Intra-host links are loopback/shm class: two orders of
+            # magnitude below the cross-host RTT, mirroring the
+            # transport/select.py shm-vs-tcp split.
+            if link.endswith("/intra"):
+                w._latency_s /= 100.0
+                w._jitter_s /= 100.0
+            self._wires[link] = w
+        return w
+
+    def _host_of(self, rank: int) -> int:
+        return rank // self.slots_per_host
+
+    def _link_to_coordinator(self, rank: int) -> str:
+        h = self._host_of(rank)
+        return "h000/intra" if h == 0 else f"h{h:03d}/cross"
+
+    # -- the real coordinator ------------------------------------------
+
+    def _requests(self, rank: int) -> List[Request]:
+        return [Request(request_rank=rank, tensor_name=f"t{i}",
+                        tensor_shape=[1024])
+                for i in range(self.tensors)]
+
+    def _make_coordinator(self, mode: str) -> Controller:
+        ctl = Controller(self.topo, _ScriptedMesh(),
+                         stall_warning_secs=0.0)
+        if mode == "fanin":
+            ctl.configure_fanin(build_plan(self.topo))
+        else:
+            ctl.fanout_topology = "star"
+        return ctl
+
+    def _warmup(self, ctl: Controller, mode: str) -> bytes:
+        """Cycle 1: every rank announces the tensors as full
+        RequestLists through the real gather (bundled per host under
+        fan-in — RequestLists ride the tree UNFOLDED, only mask frames
+        fold), so the real coordinator cache assigns the bits.  Returns
+        the steady-state full-mask bytes."""
+        from ..core.response_cache import cache_key
+
+        for sender, payload in self._frame_plan(
+                mode, lambda r: RequestList(
+                    requests=self._requests(r)).to_bytes()):
+            ctl.mesh.queue(sender, payload)
+        rlist = ctl.compute_response_list(self._requests(0))
+        assert rlist.responses, "warmup negotiated no tensors"
+        ctl.mesh.drain_sent()
+        mask = 0
+        for req in self._requests(0):
+            bit = ctl._cache.lookup(cache_key(req))
+            assert bit is not None, f"warmup did not cache {req.tensor_name}"
+            mask |= 1 << bit
+        return mask.to_bytes((mask.bit_length() + 7) // 8, "little")
+
+    def _frame_plan(self, mode: str, payload_of) -> List[Tuple[int, bytes]]:
+        """(sender, frame) pairs to queue at the coordinator for one
+        cycle — the star's np-1 raw frames, or fan-in's per-host bundles
+        (REAL ``fold_host`` + ``_encode_bundle``) plus host-0 directs."""
+        if mode == "star":
+            return [(r, payload_of(r)) for r in range(1, self.np)]
+        plan: List[Tuple[int, bytes]] = \
+            [(r, payload_of(r)) for r in range(1, self.slots_per_host)]
+        for h in range(1, self.hosts):
+            base = h * self.slots_per_host
+            ranks = range(base, base + self.slots_per_host)
+            plan.append((base, _encode_bundle(
+                fold_host([(r, payload_of(r)) for r in ranks]))))
+        return plan
+
+    # -- one steady-state cycle ----------------------------------------
+
+    def _cycle(self, ctl: Controller, mode: str, mask_bytes: bytes,
+               cycle_events: list, clock_us: float) -> dict:
+        """Drive one steady-state mask cycle through the real
+        coordinator; advance the arithmetic clock; fabricate the
+        cycle's trace spans.  Returns the cycle record."""
+        frame = MaskFrame(mask=mask_bytes).to_bytes()
+        plan = self._frame_plan(mode, lambda r: frame)
+        for sender, payload in plan:
+            ctl.mesh.queue(sender, payload)
+
+        frames_before = ctl.ingress_frame_count
+        rlist = ctl.compute_response_list(self._requests(0))
+        assert len(rlist.responses) >= 1, "mask cycle completed nothing"
+        sent = ctl.mesh.drain_sent()
+        reply = sent[0][1]
+        assert MaskFrame.from_bytes(reply).mask == mask_bytes, \
+            "agreed mask diverged from the announced full mask"
+        assert all(p == reply for _, p in sent), \
+            "broadcast payloads diverged across receivers"
+        ingress_frames = ctl.ingress_frame_count - frames_before
+        assert ingress_frames == len(plan), (ingress_frames, len(plan))
+
+        # -- arithmetic wire clock ------------------------------------
+        cycle = ctl.cycle_index
+        frame_cost = len(frame) + FRAME_OVERHEAD_BYTES
+        collect_us_by_host: Dict[int, float] = {}
+        if mode == "fanin":
+            for h in range(1, self.hosts):
+                intra = self._wire(f"h{h:03d}/intra")
+                collect_us_by_host[h] = sum(
+                    intra.delay(frame_cost) * 1e6
+                    for _ in range(self.slots_per_host - 1))
+        collect_us = max(collect_us_by_host.values(), default=0.0)
+        ingest_us = sum(
+            self._wire(self._link_to_coordinator(sender)).delay(
+                len(payload) + FRAME_OVERHEAD_BYTES) * 1e6
+            for sender, payload in plan)
+        negotiate_us = collect_us + ingest_us
+        reply_cost = len(reply) + FRAME_OVERHEAD_BYTES
+        dispatch_us = DISPATCH_COMPUTE_US + sum(
+            self._wire(self._link_to_coordinator(dst)).delay(reply_cost)
+            * 1e6 for dst, _ in sent)
+
+        # -- fabricated trace on the simulated clock ------------------
+        t = clock_us
+        for h, c_us in sorted(collect_us_by_host.items()):
+            agg = h * self.slots_per_host
+            cycle_events.append({"ph": "B", "name": "FANIN_RELAY",
+                                 "pid": agg, "tid": 0, "ts": t,
+                                 "args": {"cycle": cycle,
+                                          "members":
+                                              self.slots_per_host - 1}})
+            cycle_events.append({"ph": "E", "pid": agg, "tid": 0,
+                                 "ts": t + c_us})
+        t_ingest = t + collect_us
+        cycle_events.append({"ph": "B", "name": "NEGOTIATE_MASK",
+                             "pid": 0, "tid": 0, "ts": t_ingest,
+                             "args": {"cycle": cycle}})
+        last_sender = max(s for s, _ in plan)
+        cycle_events.append({"ph": "i", "name": str(last_sender),
+                             "pid": 0, "tid": 0,
+                             "ts": t_ingest + ingest_us})
+        cycle_events.append({"ph": "E", "pid": 0, "tid": 0,
+                             "ts": t_ingest + ingest_us})
+        cycle_events.append({"ph": "B", "name": "ALLREDUCE", "pid": 0,
+                             "tid": 0, "ts": t_ingest + ingest_us,
+                             "args": {"cycle": cycle}})
+        cycle_events.append({"ph": "E", "pid": 0, "tid": 0,
+                             "ts": t_ingest + ingest_us + dispatch_us})
+
+        return {
+            "negotiate_us": negotiate_us,
+            "dispatch_us": dispatch_us,
+            "cycle_us": negotiate_us + dispatch_us,
+            "ingress_frames": ingress_frames,
+            "reply_mask": MaskFrame.from_bytes(reply).mask_int,
+        }
+
+    # -- a run ---------------------------------------------------------
+
+    def run(self, cycles: int = 8) -> dict:
+        """Star and fan-in steady states over the same announced masks;
+        returns per-mode latency aggregates, counter-asserted ingress,
+        the critical-path attribution of the fan-in trace, and the
+        determinism digest."""
+        out: Dict[str, dict] = {}
+        traces: Dict[str, list] = {}
+        for mode in ("star", "fanin"):
+            ctl = self._make_coordinator(mode)
+            mask_bytes = self._warmup(ctl, mode)
+            events: list = []
+            clock_us = 0.0
+            recs = []
+            for _ in range(cycles):
+                rec = self._cycle(ctl, mode, mask_bytes, events, clock_us)
+                # 1us inter-cycle idle gap: consecutive cycles' spans must
+                # never abut exactly — float accumulation could order the
+                # next begin a few ulps before this cycle's end and
+                # scramble the reconstructed span stack at the boundary.
+                clock_us += rec["cycle_us"] + 1.0
+                recs.append(rec)
+            traces[mode] = events
+            neg = sorted(r["negotiate_us"] for r in recs)
+            cyc = sorted(r["cycle_us"] for r in recs)
+            expected = self.np - 1 if mode == "star" \
+                else (self.hosts - 1) + (self.slots_per_host - 1)
+            assert all(r["ingress_frames"] == expected for r in recs), \
+                (mode, expected, [r["ingress_frames"] for r in recs])
+            out[mode] = {
+                "ingress_frames_per_cycle": expected,
+                "negotiate_ms_p50": round(neg[len(neg) // 2] / 1e3, 4),
+                "cycle_ms_p50": round(cyc[len(cyc) // 2] / 1e3, 4),
+                "cycle_ms_max": round(cyc[-1] / 1e3, 4),
+                "reply_mask": recs[0]["reply_mask"],
+            }
+        assert out["star"]["reply_mask"] == out["fanin"]["reply_mask"], \
+            "fan-in agreed mask is not bit-identical to the star's"
+
+        from ..tools.critical_path import analyze
+
+        attribution = {}
+        for mode, events in traces.items():
+            doc = analyze(events)
+            entry = {"coverage": doc["coverage"],
+                     "steps": len(doc["steps"])}
+            if mode == "fanin":
+                fanin_us = sum(d.get("fanin", 0.0)
+                               for d in doc["totals_us"].values())
+                total_us = sum(sum(d.values())
+                               for d in doc["totals_us"].values())
+                entry["fanin_share"] = round(
+                    fanin_us / total_us, 4) if total_us else 0.0
+            attribution[mode] = entry
+
+        return {
+            "np": self.np,
+            "hosts": self.hosts,
+            "slots_per_host": self.slots_per_host,
+            "tensors": self.tensors,
+            "cycles": cycles,
+            "star": out["star"],
+            "fanin": out["fanin"],
+            "ingress_reduction": round(
+                out["star"]["ingress_frames_per_cycle"]
+                / out["fanin"]["ingress_frames_per_cycle"], 2),
+            "negotiate_speedup_p50": round(
+                out["star"]["negotiate_ms_p50"]
+                / max(out["fanin"]["negotiate_ms_p50"], 1e-9), 2),
+            "cycle_speedup_p50": round(
+                out["star"]["cycle_ms_p50"]
+                / max(out["fanin"]["cycle_ms_p50"], 1e-9), 2),
+            "attribution": attribution,
+        }
+
+    def determinism_digest(self) -> str:
+        """SHA-256 over everything that shapes the run: seed, topology,
+        frame geometry, and every link's fresh-stream wire preview —
+        same-seed runs produce byte-identical digests (the artifact's
+        reproducibility witness, mirroring ``SimCluster``)."""
+        links = ["h000/intra"]
+        for h in range(1, self.hosts):
+            links += [f"h{h:03d}/intra", f"h{h:03d}/cross"]
+        blob = json.dumps({
+            "seed": self.seed, "np": self.np,
+            "slots_per_host": self.slots_per_host,
+            "tensors": self.tensors,
+            "frame_overhead_bytes": FRAME_OVERHEAD_BYTES,
+            "dispatch_compute_us": DISPATCH_COMPUTE_US,
+            "wire_previews": {link: self._wire(link).preview(4096, 4)
+                              for link in links},
+        }, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+
+def run_curve(np_list: List[int], slots_per_host: int = 8,
+              tensors: int = 4, seed: Optional[int] = None,
+              cycles: int = 8) -> dict:
+    """The committed-artifact record: star-vs-tree negotiate/dispatch
+    latency curves across ``np_list``, each point driven through the
+    real coordinator."""
+    if seed is None:
+        seed = env_mod.get_int(env_mod.HOROVOD_SIM_SEED, 0)
+    points = []
+    digests = {}
+    for np in np_list:
+        sim = SimNegotiation(np, slots_per_host=slots_per_host,
+                             tensors=tensors, seed=seed)
+        points.append(sim.run(cycles=cycles))
+        digests[str(np)] = sim.determinism_digest()
+    return {
+        "metric": "sim_negotiation",
+        "seed": seed,
+        "slots_per_host": slots_per_host,
+        "tensors": tensors,
+        "cycles": cycles,
+        "curve": points,
+        "determinism": {"digests": digests},
+    }

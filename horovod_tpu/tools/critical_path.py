@@ -28,6 +28,11 @@ Phase mapping:
   for — not to the coordinator that emitted the span.  Mask-path
   negotiations (no table spans) contribute nothing; run the workload with
   unique tensor names per step to see negotiation attribution.
+- ``FANIN_*`` → ``fanin``: the tree-negotiation hop (a host aggregator
+  collecting, folding and relaying its members' mask frames,
+  ``core/negotiation_fanin.py``) gets its own disjoint phase so the
+  O(hosts) ingress optimisation is attributable separately from both the
+  coordinator's negotiation wait and dispatch.
 - ``LC_FUSE``/``LC_UNFUSE``/``MEMCPY*`` → ``fusion``
 - ``LC_WIRE_ALLGATHER``/``LC_WIRE_CROSS``/``LC_AG_STEP`` → ``wire``
 - ``*DIGEST*`` → ``digest`` (reserved: the shadow digest pipeline does
@@ -52,8 +57,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .trace_merge import load_trace, merge
 
-PHASES = ("negotiation_wait", "fusion", "wire", "digest", "reduce",
-          "dispatch")
+PHASES = ("negotiation_wait", "fanin", "fusion", "wire", "digest",
+          "reduce", "dispatch")
 
 _OP_SPANS = {"ALLREDUCE", "ALLGATHER", "BROADCAST", "ALLTOALL", "ADASUM",
              "BARRIER", "JOIN", "LC_CALLBACK"}
@@ -63,6 +68,8 @@ _REDUCE_SPANS = {"LC_WIRE_REDUCE_SCATTER", "LC_RS_STEP"}
 
 
 def _phase_of(name: str) -> Optional[str]:
+    if name.startswith("FANIN_"):
+        return "fanin"
     if name in _FUSION_SPANS or "MEMCPY" in name:
         return "fusion"
     if name in _WIRE_SPANS:
@@ -175,12 +182,19 @@ def analyze(events: List[dict]) -> dict:
     steps = []
     totals: Dict[int, Dict[str, float]] = {}
     critical_counts: Dict[int, int] = {}
+    covered_total = 0.0
+    wall_total = 0.0
     for cycle in sorted(by_cycle):
         group = by_cycle[cycle]
         t0 = min(s.b for s in group)
         t1 = max(s.e for s in group)
         critical = max(group, key=lambda s: s.e)
         phases: Dict[int, Dict[str, float]] = {}
+        # step-window intervals that got a phase attribution, any rank —
+        # their union vs the wall clock is the step's coverage (the
+        # control_path.py idiom: unattributed time is where the tool is
+        # blind, and regressions there must be loud).
+        covered_iv: List[Tuple[float, float]] = []
 
         def charge(rank, phase, us):
             if us <= 0:
@@ -198,6 +212,8 @@ def analyze(events: List[dict]) -> dict:
             if ready:
                 ts_last, rank_last = max(ready)
                 charge(rank_last, "negotiation_wait", ts_last - s.b)
+                if ts_last > s.b:
+                    covered_iv.append((s.b, ts_last))
 
         ranks = {s.pid for s in group}
         for rank in ranks:
@@ -211,11 +227,15 @@ def analyze(events: List[dict]) -> dict:
                     per_phase[p].append((s.b, s.e))
             unions = {p: _union(iv) for p, iv in per_phase.items()}
             # dispatch = op-span time not already attributed elsewhere
-            cut = _union([iv for p in ("fusion", "wire", "digest", "reduce")
+            cut = _union([iv
+                          for p in ("fanin", "fusion", "wire", "digest",
+                                    "reduce")
                           for iv in unions[p]])
             unions["dispatch"] = _subtract(unions["dispatch"], cut)
-            for p in ("fusion", "wire", "digest", "reduce", "dispatch"):
+            for p in ("fanin", "fusion", "wire", "digest", "reduce",
+                      "dispatch"):
                 charge(rank, p, _total(unions[p]))
+                covered_iv.extend(unions[p])
 
         dominant = {"rank": None, "phase": None, "us": 0.0}
         for rank, d in phases.items():
@@ -224,13 +244,21 @@ def analyze(events: List[dict]) -> dict:
                     dominant = {"rank": rank, "phase": p, "us": us}
         critical_counts[critical.pid] = \
             critical_counts.get(critical.pid, 0) + 1
+        wall = t1 - t0
+        cov_us = _total(_union(
+            [(max(b, t0), min(e, t1)) for b, e in covered_iv if e > b]))
+        cov_us = min(cov_us, wall)
+        covered_total += cov_us
+        wall_total += wall
         steps.append({
             "cycle": cycle,
             "t0_us": round(t0, 1),
-            "duration_us": round(t1 - t0, 1),
+            "duration_us": round(wall, 1),
             "critical_rank": critical.pid,
             "critical_span": critical.name,
             "dominant": {**dominant, "us": round(dominant["us"], 1)},
+            "unattributed_us": round(wall - cov_us, 1),
+            "coverage": round(cov_us / wall, 4) if wall > 0 else 1.0,
             "phases_us": {str(r): {p: round(us, 1) for p, us in d.items()}
                           for r, d in sorted(phases.items())},
         })
@@ -243,6 +271,8 @@ def analyze(events: List[dict]) -> dict:
                                  in sorted(critical_counts.items())},
         "totals_us": {str(r): {p: round(us, 1) for p, us in d.items()}
                       for r, d in sorted(totals.items())},
+        "coverage": round(covered_total / wall_total, 4)
+        if wall_total > 0 else 1.0,
     }
 
 
@@ -259,6 +289,9 @@ def render_text(doc: dict, top: int = 10) -> str:
     worst_rank = max(counts, key=lambda r: counts[r])
     lines.append(f"critical rank by step count: rank {worst_rank} "
                  f"({counts[worst_rank]}/{len(steps)} steps)")
+    if "coverage" in doc:
+        lines.append(f"attribution coverage: {doc['coverage']:.1%} of "
+                     "step wall time carries a phase")
     lines.append("")
     lines.append("aggregate attribution (ms, union of span time per "
                  "rank/phase):")

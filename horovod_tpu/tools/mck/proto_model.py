@@ -1246,7 +1246,15 @@ class ProtoExecution:
 
 
 def proto_execution_factory(scenario, model, mutation=None,
-                            max_steps: int = 600) -> ProtoExecution:
+                            max_steps: int = 600):
     """``execution_factory`` for :func:`explore.check`; ``model`` is the
-    mode label ("proto") and carries no semantics here."""
+    mode label ("proto") and carries no semantics here.  Scenarios with
+    ``kind == "fanin"`` route to the negotiation fan-in degrade model
+    (fanin_model.py), which shares this mode's action vocabulary and
+    therefore its ``proto_unit`` pricing."""
+    if getattr(scenario, "kind", "proto") == "fanin":
+        from .fanin_model import FaninExecution
+
+        return FaninExecution(scenario, mutation=mutation,
+                              max_steps=max_steps)
     return ProtoExecution(scenario, mutation=mutation, max_steps=max_steps)

@@ -26,6 +26,7 @@ from ...elastic.driver import (
     STEP_TXN,
 )
 from ...transport.store import STEP_JOURNAL, STEP_REPLY
+from .fanin_model import V_FANIN_BIT_LOST, fanin_bits_dropped_wrap
 from .mutations import Mutation
 from .proto_model import (
     V_ACKED_LOST,
@@ -231,4 +232,12 @@ PROTO_MUTATIONS: Dict[str, Mutation] = {m.name: m for m in (
         description="reshard marker kept while a previous reshard is "
                     "still uncommitted (legacy-fallback branch deleted)",
         wrap=_reshard_fallback_dropped),
+    Mutation(
+        "fanin_bits_dropped", role="fanin_forward",
+        scenario="fanin_degrade",
+        expected=frozenset({V_FANIN_BIT_LOST}),
+        description="aggregator zeroes one member's mask on forward "
+                    "while still covering its rank (bits dropped from "
+                    "the host fold)",
+        wrap=fanin_bits_dropped_wrap),
 )}

@@ -257,3 +257,11 @@ PROTO_SCENARIOS: Dict[str, ProtoScenario] = {s.name: s for s in (
         ],
         clock_steps=[11.0], reshard=True),
 )}
+
+# The negotiation fan-in degrade scenario rides the same registry so the
+# CLI, the smoke gate, and the kill suite cover it with zero extra
+# plumbing; its execution model lives in fanin_model.py and is routed by
+# scenario.kind in proto_model.proto_execution_factory.
+from .fanin_model import FANIN_DEGRADE  # noqa: E402
+
+PROTO_SCENARIOS[FANIN_DEGRADE.name] = FANIN_DEGRADE

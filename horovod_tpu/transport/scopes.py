@@ -48,6 +48,14 @@ WORKERS_SCOPE = "workers"
 #: Worker → driver metrics snapshots, one key per rank.
 METRICS_SCOPE = "metrics"
 
+#: Worker → coordinator negotiation-fan-in vetoes: ``hostname`` →
+#: ``{"epoch": N, "reason": ...}`` written best-effort by a member that
+#: convicted its host's negotiation aggregator as wedged
+#: (AggregatorStaleError); rank 0 reads the scope at the next epoch's
+#: fan-in sync and keeps convicted hosts on the direct path for the
+#: veto-cooldown window (docs/data_plane.md "Negotiation fan-in").
+NEGOTIATION_VETO_SCOPE = "negotiation_veto"
+
 ALL_SCOPES = (
     DRIVER_SCOPE,
     RANK_AND_SIZE_SCOPE,
@@ -57,4 +65,5 @@ ALL_SCOPES = (
     DEMOTION_REPORT_SCOPE,
     WORKERS_SCOPE,
     METRICS_SCOPE,
+    NEGOTIATION_VETO_SCOPE,
 )

@@ -10,7 +10,10 @@ import pytest
 
 from horovod_tpu.core.messages import DataType, Request, RequestType
 from horovod_tpu.core.parameter_manager import (
+    _CODECS,
+    _sign_test_p,
     BayesianOptimization,
+    CodecArm,
     GaussianProcess,
     ParameterManager,
 )
@@ -200,6 +203,113 @@ class TestParameterManager:
         assert abs(float(bcycle) - pm.cycle_time_ms) < 0.01
         assert abs(float(bfusion)
                    - pm.fusion_threshold_bytes / 1048576.0) < 0.01
+
+    def test_codec_sign_test_matches_ab_harness(self):
+        """The local gate must be numerically identical to the PR-10 A/B
+        harness sign test — one formula, two call sites."""
+        from benchmarks.ab_harness import sign_test_p
+
+        for wins in range(0, 12):
+            for losses in range(0, 12):
+                assert _sign_test_p(wins, losses) == \
+                    sign_test_p(wins, losses), (wins, losses)
+
+    def test_codec_dimension_default_off(self):
+        """HOROVOD_AUTOTUNE_CODEC defaults off: no arm, baseline codec
+        reported, and the established 4-column CSV schema untouched
+        (test_autotune_log_csv_artifact asserts the header verbatim)."""
+        pm = ParameterManager(enabled=True, warmup_samples=0,
+                              steps_per_sample=1, max_samples=2)
+        assert pm._codec_arm is None
+        assert pm.codec_under_test == "none"
+        for _ in range(5):
+            pm.update(nbytes=1 << 20)
+        assert pm.recommended_codec == "none"
+
+    def test_codec_arm_pairs_baseline_then_candidate(self):
+        """Samples alternate baseline/candidate and candidates rotate
+        round-robin, so every codec keeps accruing sign-test pairs."""
+        arm = CodecArm()
+        seen = []
+        for i in range(2 * len(_CODECS[1:])):
+            seen.append(arm.under_test)
+            arm.observe(100.0)
+        assert seen[0::2] == ["none"] * len(_CODECS[1:])
+        assert seen[1::2] == list(_CODECS[1:])
+
+    def test_codec_recommended_only_on_significant_win(self):
+        """A candidate needs a lopsided paired record to clear the gate:
+        6-0 over "none" is p=0.03125 < 0.05 and is recommended; a 3-3
+        split (p=1.0) and even a 4-1 edge (p=0.375) are not.  Ties are
+        discarded, like the harness."""
+        codecs = ("none", "int8")
+        win6 = CodecArm(codecs=codecs)
+        for _ in range(6):
+            win6.observe(100.0)     # baseline
+            win6.observe(150.0)     # candidate wins
+        assert win6.recommendation() == ("int8", _sign_test_p(6, 0))
+
+        split = CodecArm(codecs=codecs)
+        for cand in (150.0, 150.0, 150.0, 50.0, 50.0, 50.0):
+            split.observe(100.0)
+            split.observe(cand)
+        assert split.recommendation() == ("none", 1.0)
+
+        edge = CodecArm(codecs=codecs)
+        for cand in (150.0, 150.0, 150.0, 150.0, 50.0):
+            edge.observe(100.0)
+            edge.observe(cand)
+        assert edge.recommendation() == ("none", 1.0)
+
+        ties = CodecArm(codecs=codecs)
+        for _ in range(20):
+            ties.observe(100.0)
+            ties.observe(100.0)     # tie: no pair recorded
+        assert ties._wins["int8"] == 0 and ties._losses["int8"] == 0
+        assert ties.recommendation() == ("none", 1.0)
+
+    def test_codec_column_in_autotune_log(self, tmp_path):
+        """With the arm on, every CSV row carries the codec the sample
+        was attributed to and the best row carries the sign-test-gated
+        verdict — the report-only surface the env knob promises."""
+        log = tmp_path / "autotune.csv"
+        pm = ParameterManager(enabled=True, warmup_samples=1,
+                              steps_per_sample=2, max_samples=4,
+                              log_path=str(log), tune_codec=True)
+        for _ in range(40):
+            pm.update(nbytes=1 << 20)
+        assert pm._done
+        lines = log.read_text().strip().splitlines()
+        assert lines[0].endswith(",codec")
+        for row in lines[1:-1]:
+            assert row.split(",")[-1] in _CODECS
+        best = lines[-1].split(",")
+        assert best[0] == "best" and len(best) == 5
+        assert best[-1] == pm.recommended_codec
+        # Real cycles are near-identical in score; a significant codec
+        # win cannot appear from a handful of noisy pairs.
+        assert pm.recommended_codec == "none"
+
+    def test_codec_knob_wires_into_state(self, monkeypatch):
+        """HOROVOD_AUTOTUNE_CODEC=1 at init turns the arm on for the
+        coordinator's manager (core/state.py wiring); without it the
+        manager tunes but reports the baseline codec only."""
+        import horovod_tpu.frameworks.jax.basics as basics
+        from horovod_tpu.common import env as env_mod
+        from horovod_tpu.core import state as state_mod
+
+        monkeypatch.delenv("HOROVOD_SIZE", raising=False)
+        monkeypatch.setenv(env_mod.HOROVOD_AUTOTUNE, "1")
+        monkeypatch.setenv(env_mod.HOROVOD_AUTOTUNE_CODEC, "1")
+        state_mod.reset_global_state()
+        basics.init()
+        try:
+            pm = state_mod.global_state().parameter_manager
+            assert pm is not None and pm._codec_arm is not None
+            assert pm.codec_under_test == "none"   # baseline half first
+        finally:
+            state_mod.global_state().shutdown()
+            state_mod.reset_global_state()
 
 
 class TestStallInspector:

@@ -216,7 +216,8 @@ def test_controller_topology_mismatch_is_loud():
         st = HorovodGlobalState()
         st.topo = ProcessTopology(rank=rank, size=2, local_rank=rank,
                                   local_size=2)
-        st.controller = types.SimpleNamespace(fanout_topology=fanout)
+        st.controller = types.SimpleNamespace(fanout_topology=fanout,
+                                              configure_fanin=lambda plan: None)
         return st
 
     fake_state(0, "star")._sync_controller_topology(store, 0, timeout=5)

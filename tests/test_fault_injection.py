@@ -1029,6 +1029,124 @@ def test_live_reshard_kill_switch_falls_back_and_still_converges(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# negotiation fan-in aggregator death (docs/data_plane.md "Negotiation
+# fan-in"): np=4 on TWO loopback hosts — the smallest layout that trees
+# ---------------------------------------------------------------------------
+
+# Keyed on HOROVOD_RANK (not LOCAL_RANK: two loopback hosts collide on
+# local_rank 0) so the respawned aggregator incarnation disarms the kill
+# before the faults registry parses it at import.
+_FANIN_DISARM_PREAMBLE = """
+import os
+_flag = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "spawned_%s" % os.environ.get("HOROVOD_RANK"))
+if os.path.exists(_flag):
+    os.environ.pop("HOROVOD_FAULT_SPEC", None)
+else:
+    open(_flag, "w").close()
+"""
+
+
+_ELASTIC_FANIN_TRAIN = """
+import numpy as np
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+import horovod_tpu as hvd
+
+hvd.init()
+from horovod_tpu.core.state import global_state
+_plan = global_state().controller.fanin_plan
+print("FANIN_ROLE r%d %s" % (
+    hvd.rank(), _plan.role if _plan is not None else "none"), flush=True)
+state = hvd.elastic.ObjectState(batch=0, params=np.zeros(4, np.float32))
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 15:
+        grad = hvd.allreduce(
+            np.full(4, float(state.batch + 1), np.float32),
+            op=hvd.Sum, name="g")
+        state.params = state.params + np.asarray(grad)
+        state.batch += 1
+        state.commit()
+
+train(state)
+print("FINAL_PARAMS r%d %s" % (
+    hvd.rank(), np.asarray(state.params).tobytes().hex()), flush=True)
+print("ELASTIC_DONE", hvd.rank(), flush=True)
+hvd.shutdown()
+"""
+
+
+def _run_fanin_death_job(tmp_path, fault_spec, extra_env=None):
+    """np=4 elastic job on TWO loopback hosts (2 slots each): the blocked
+    2x2 layout turns tree negotiation fan-in on (auto), making rank 2 the
+    host-1 aggregator.  Returns (rank->params map, proc)."""
+    arm = "fault" if fault_spec else "clean"
+    jobdir = tmp_path / arm
+    jobdir.mkdir()
+    disc = jobdir / "discover.sh"
+    disc.write_text("#!/bin/sh\necho localhost:2\necho 127.0.0.1:2\n")
+    disc.chmod(0o755)
+    train = jobdir / "train.py"
+    train.write_text(_FANIN_DISARM_PREAMBLE + _ELASTIC_FANIN_TRAIN)
+
+    env = os.environ.copy()
+    env.update(_FAST_DEADLINE)
+    env.update(_RESHARD_KNOBS)
+    env.update(extra_env or {})
+    env["HOROVOD_LOG_LEVEL"] = "info"
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    if fault_spec:
+        env["HOROVOD_FAULT_SPEC"] = fault_spec
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "4", "--min-np", "4",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(train)],
+        cwd=REPO_ROOT, text=True, env=env,
+        capture_output=True, timeout=360)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])
+    params = dict(re.findall(r"FINAL_PARAMS r(\d+) ([0-9a-f]+)",
+                             proc.stdout))
+    assert set(params) == {str(r) for r in range(4)}, proc.stdout[-2000:]
+    assert len(set(params.values())) == 1, "ranks diverged"
+    return params, proc
+
+
+@pytest.mark.timeout(600)
+def test_fanin_aggregator_death_np4_reconverges_bit_identical(tmp_path):
+    """An aggregator death must never silence its host or lose a
+    readiness bit: rank 2 (host 1's negotiation aggregator) is SIGKILL'd
+    mid-train; its member's blocking recv raises PeerGoneError promptly,
+    the coordinated abort discards the in-flight cycle on every path,
+    the PR 19 reshard respawns exactly the victim's identity, and the
+    re-treed epoch finishes BIT-identical to an undisturbed run — the
+    stateless-fold property live (every cycle re-announces the full
+    mask, so the discarded cycle loses nothing).  The wedge flavor
+    (stale heartbeat -> veto -> direct) is exhaustively model-checked in
+    test_mck_proto.py and unit-covered in test_negotiation_fanin.py."""
+    clean, cproc = _run_fanin_death_job(tmp_path, None)
+    faulted, proc = _run_fanin_death_job(
+        tmp_path, "dispatch.collective:rank=2:nth=8:action=exit,9")
+    assert faulted == clean, \
+        "aggregator-death recovery did not converge to the no-fault run"
+    # The tree was live in both runs and rank 2 WAS host 1's aggregator
+    # (the respawned incarnation re-trees into the same role).
+    for out in (cproc.stdout, proc.stdout):
+        roles = dict(re.findall(r"FANIN_ROLE r(\d+) (\w+)", out))
+        assert roles == {"0": "coordinator", "1": "direct",
+                         "2": "aggregator", "3": "member"}, out[-2000:]
+    # Zero-restart recovery: exactly one post-churn spawn, the dead
+    # aggregator's identity.
+    later = [ident for ident, ep in _spawns_by_epoch(proc.stderr) if ep > 0]
+    assert later == ["127.0.0.1:0"], proc.stderr[-3000:]
+
+
+# ---------------------------------------------------------------------------
 # control-plane survivability (docs/control_plane.md)
 # ---------------------------------------------------------------------------
 

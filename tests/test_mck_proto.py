@@ -155,6 +155,80 @@ def test_proto_mutation_suite_is_nontrivial():
 
 
 # ---------------------------------------------------------------------------
+# the negotiation fan-in degrade model (fanin_model.py)
+# ---------------------------------------------------------------------------
+
+def test_fanin_sleep_sets_prune_schedules_not_verdicts():
+    # The fan-in footprints prune ~99% of the schedule space, which is
+    # exactly when an unsound footprint would hide a bug silently — so
+    # diff reduced vs unreduced where the unreduced run still completes
+    # (bound 0; crash and clock actions are free, so bound 0 already
+    # explores the aggregator crashed and staled at every position).
+    reduced = _explore("fanin_degrade", bound=0)
+    full = _explore("fanin_degrade", bound=0, sleep_sets=False)
+    assert sorted(reduced.violations) == sorted(full.violations) == []
+    assert reduced.complete and full.complete
+    assert reduced.schedules <= full.schedules
+    mut = PROTO_MUTATIONS["fanin_bits_dropped"]
+    mreduced = _explore("fanin_degrade", mutation=mut, bound=0)
+    mfull = _explore("fanin_degrade", mutation=mut, bound=0,
+                     sleep_sets=False)
+    assert set(mreduced.violations) & mut.expected
+    assert set(mfull.violations) & mut.expected
+
+
+def test_fanin_degrade_falls_back_direct_with_o_hosts_ingress():
+    # One deterministic schedule through the model itself: a clean tree
+    # round lands ONE bundle at the coordinator (vs 3 worker frames —
+    # the O(hosts)-vs-O(ranks) claim in miniature), then the aggregator
+    # is crashed mid-collect and the heartbeat staled: the conviction
+    # must veto the host, degrade everyone to direct, and the retry
+    # round must re-deliver every announced bit exactly.
+    from horovod_tpu.tools.mck.fanin_model import FANIN_DEGRADE, \
+        FaninExecution
+
+    ex = FaninExecution(FANIN_DEGRADE)
+    script = [
+        ("p", "m4"), ("p", "m5"),    # members push to the aggregator
+        ("p", "agg"),                # fold_host -> one bundle upward
+        ("p", "coord"),              # round 0 completes off 1 frame
+        ("p", "agg"),                # relay the agreed mask down
+        ("p", "m4"), ("p", "m5"),    # consume cycle-0 replies
+        ("p", "m4"),                 # cycle 1: m4 pushes to the agg...
+        ("c", "agg"),                # ...which dies holding its frame
+        ("k", 0),                    # heartbeat goes stale
+        ("p", "m4"),                 # conviction -> abort -> veto
+        ("p", "m4"), ("p", "m5"),    # retry DIRECT (full re-announce)
+        ("p", "coord"),              # round 1 completes off 2 frames
+        ("p", "m4"), ("p", "m5"),    # consume cycle-1 replies
+    ]
+    for act in script:
+        assert act in ex.enabled_actions(), (act, ex.trace)
+        ex.step(act)
+    assert ex.final_check() is None, ex.final_check()
+    assert ex.vetoed and ex.mode == "direct" and ex.fallbacks == 1
+    masks = FANIN_DEGRADE.masks
+    tree, direct = ex.completions
+    # tree round: one bundle covers all three ranks, AND-exact
+    assert tree["ingress_frames"] == 1
+    assert tree["covered"] == (3, 4, 5)
+    assert tree["agreed"] == masks["agg"] & masks["m4"] & masks["m5"]
+    # degraded round: per-rank direct frames (the dead aggregator's
+    # rank is excused), still AND-exact — nothing consumed by the dead
+    # aggregator was lost, because the members re-announced in full
+    assert direct["ingress_frames"] == 2
+    assert direct["covered"] == (4, 5)
+    assert direct["agreed"] == masks["m4"] & masks["m5"]
+
+
+def test_fanin_listed_with_proto_scenarios(capsys):
+    assert main(["proto", "--list"]) == 0
+    out = capsys.readouterr().out
+    assert "fanin_degrade" in out
+    assert "fanin_bits_dropped" in out
+
+
+# ---------------------------------------------------------------------------
 # byte-level crash points collapse to frame boundaries
 # ---------------------------------------------------------------------------
 

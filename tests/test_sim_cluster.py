@@ -302,3 +302,76 @@ def test_sim_reshard_respects_min_np_quorum_during_demotion(monkeypatch):
         assert not cluster.driver.finished()
     finally:
         cluster.stop()
+
+# ---------------------------------------------------------------------------
+# negotiation fan-in sim (horovod_tpu/sim/negotiation.py, docs/data_plane.md
+# "Negotiation fan-in"): the REAL coordinator mask path at large np over an
+# arithmetic wire clock — no processes, no sleeping.
+
+
+def test_sim_negotiation_counters_and_bit_exactness():
+    """np=64 smoke of every claim the big artifact makes: the real
+    coordinator ingests O(ranks) star frames vs O(hosts) fan-in frames
+    (counter-asserted against controller_ingress_frames_total's backing
+    counter), the agreed mask is bit-identical across shapes, and the
+    fabricated trace attributes >= 0.90 of every step."""
+    from horovod_tpu.sim.negotiation import SimNegotiation
+
+    rec = SimNegotiation(64, slots_per_host=8, seed=0).run(cycles=3)
+    assert rec["star"]["ingress_frames_per_cycle"] == 63
+    assert rec["fanin"]["ingress_frames_per_cycle"] == 7 + 7
+    assert rec["star"]["reply_mask"] == rec["fanin"]["reply_mask"] != 0
+    assert rec["fanin"]["cycle_ms_p50"] < rec["star"]["cycle_ms_p50"]
+    for mode in ("star", "fanin"):
+        assert rec["attribution"][mode]["coverage"] >= 0.90, \
+            rec["attribution"]
+    assert rec["attribution"]["fanin"]["fanin_share"] > 0
+
+
+def test_sim_negotiation_digest_deterministic():
+    from horovod_tpu.sim.negotiation import SimNegotiation
+
+    a = SimNegotiation(128, slots_per_host=8, seed=3)
+    b = SimNegotiation(128, slots_per_host=8, seed=3)
+    other = SimNegotiation(128, slots_per_host=8, seed=4)
+    assert a.determinism_digest() == b.determinism_digest()
+    assert a.determinism_digest() != other.determinism_digest()
+
+
+@pytest.mark.slow
+def test_sim_negotiation_np4096_artifact():
+    """Regenerates ``benchmarks/results/sim_negotiation_np4096.json``
+    (the committed star-vs-tree latency curves, np=1024-4096) through
+    the real coordinator and asserts every claim it makes — monotone
+    ingress reduction, bit-identical masks at every size, attribution
+    coverage >= 0.90, and digests that reproduce from fresh same-seed
+    sims (the non-fabrication witness).  Run by ci/chaos.sh."""
+    import os
+
+    from horovod_tpu.sim.negotiation import SimNegotiation, run_curve
+
+    from .helpers import REPO_ROOT
+
+    rec = run_curve([1024, 2048, 4096], slots_per_host=8, seed=0,
+                    cycles=6)
+    assert [p["np"] for p in rec["curve"]] == [1024, 2048, 4096]
+    for p in rec["curve"]:
+        star, fanin = p["star"], p["fanin"]
+        assert star["ingress_frames_per_cycle"] == p["np"] - 1
+        assert fanin["ingress_frames_per_cycle"] == \
+            (p["hosts"] - 1) + (p["slots_per_host"] - 1)
+        assert star["reply_mask"] == fanin["reply_mask"] != 0
+        assert p["cycle_speedup_p50"] > 2.0, p
+        for mode in ("star", "fanin"):
+            assert p["attribution"][mode]["coverage"] >= 0.90, \
+                p["attribution"]
+        # Non-fabrication: pure function of (seed, topology, shaping).
+        assert rec["determinism"]["digests"][str(p["np"])] == \
+            SimNegotiation(p["np"], slots_per_host=8,
+                           seed=0).determinism_digest()
+    out = os.path.join(REPO_ROOT, "benchmarks", "results",
+                       "sim_negotiation_np4096.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    with open(out) as f:
+        assert json.loads(f.read()) == rec
